@@ -1,0 +1,3 @@
+from .auto_cast import amp_guard, auto_cast, decorate  # noqa
+from .grad_scaler import AmpScaler, GradScaler  # noqa
+from . import debugging  # noqa

@@ -1,0 +1,88 @@
+"""AMP autocast (reference: `python/paddle/amp/auto_cast.py:687`, `decorate` :755).
+
+Hooks into `core.tensor.apply` — the same interposition point as the reference's
+AMP_LOGIC stage in generated ad_funcs.  bf16-first: O1 casts white-list op inputs to
+bf16 (TPU-native), black-list to fp32; O2 casts parameters once (decorate) and keeps
+master weights in the optimizer.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ..core import dtype as _dt
+from ..core import tensor as _tensor_mod
+from ..core.tensor import Tensor
+from . import amp_lists
+
+
+class _AmpState:
+    def __init__(self, enabled, dtype, level, custom_white_list, custom_black_list):
+        self.enabled = enabled
+        self.dtype = _dt.to_np(dtype)
+        self.level = level
+        self.white = amp_lists.white_list() | set(custom_white_list or ())
+        self.black = (amp_lists.black_list() | set(custom_black_list or ())) - set(custom_white_list or ())
+
+    def cast_inputs(self, op_name, inputs):
+        if self.level == "O2":
+            # O2: everything except black list runs in low precision
+            target = jnp.float32 if op_name in self.black else self.dtype
+        elif op_name in self.white:
+            target = self.dtype
+        elif op_name in self.black:
+            target = jnp.float32
+        else:
+            return inputs  # gray: leave as-is
+        out = []
+        for x in inputs:
+            if isinstance(x, Tensor) and jnp.issubdtype(x._data.dtype, jnp.floating) \
+                    and x._data.dtype != jnp.float64 and x._data.dtype != target:
+                out.append(x.astype(target))
+            else:
+                out.append(x)
+        return out
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1",
+              dtype="bfloat16", use_promote=True):
+    """paddle.amp.auto_cast: bf16 by default on TPU (fp16 accepted and honoured)."""
+    prev = _tensor_mod._amp_state
+    state = _AmpState(enable, dtype, level, custom_white_list, custom_black_list) \
+        if enable else None
+    _tensor_mod._set_amp_state(state)
+    try:
+        yield
+    finally:
+        _tensor_mod._set_amp_state(prev)
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """O2 decoration: cast model params to low precision; optimizers keep fp32
+    accumulators (they already do — see optimizer/*: all state is fp32 = master
+    weights)."""
+    from ..nn.layer.layers import Layer
+    from ..nn.layer.norm import _BatchNormBase, LayerNorm
+
+    single_model = isinstance(models, Layer)
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        npd = _dt.to_np(dtype)
+        excluded = tuple(excluded_layers) if excluded_layers else (_BatchNormBase, LayerNorm)
+        for m in model_list:
+            for lyr in m.sublayers(include_self=True):
+                if isinstance(lyr, excluded):
+                    continue
+                for p in lyr._parameters.values():
+                    if p is not None and jnp.issubdtype(p._data.dtype, jnp.floating):
+                        p._data = p._data.astype(npd)
+    if optimizers is None:
+        return models if single_model else model_list
+    return (models if single_model else model_list), optimizers

@@ -1,0 +1,29 @@
+"""paddle.autograd parity: grad, backward, PyLayer, hooks."""
+from ..core.autograd import grad, no_grad, enable_grad, set_grad_enabled, is_grad_enabled  # noqa
+from ..core import autograd as _ag
+from .py_layer import PyLayer, PyLayerContext  # noqa
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward."""
+    _ag.run_backward(tensors, grad_tensors, retain_graph)
+
+
+class saved_tensors_hooks:
+    """API-compat context (`paddle.autograd.saved_tensors_hooks`): registers pack/unpack
+    hooks for tensors saved for backward.  The tape stores pullback closures rather than
+    tensors, so hooks apply to PyLayer saved tensors only."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        from . import py_layer
+        py_layer._saved_hooks.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        from . import py_layer
+        py_layer._saved_hooks.pop()
+        return False

@@ -1,0 +1,111 @@
+"""PyLayer: user-defined autograd functions.
+
+Reference parity: `paddle.autograd.PyLayer` (`paddle/fluid/eager/pylayer/`,
+`fluid/pybind/eager_py_layer.cc`).  The user supplies `forward(ctx, ...)` and
+`backward(ctx, *out_grads)` static methods; apply() records a GradNode whose pullback
+invokes the user's backward.
+"""
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax.numpy as jnp
+
+from ..core import autograd as _ag
+from ..core.tensor import Tensor
+
+_saved_hooks: List = []
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self._materialize_grads = True
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        if _saved_hooks:
+            pack, _ = _saved_hooks[-1]
+            self._saved = tuple(pack(t) for t in tensors)
+            self._packed = True
+        else:
+            self._saved = tensors
+            self._packed = False
+
+    def saved_tensor(self):
+        if getattr(self, "_packed", False):
+            _, unpack = _saved_hooks[-1] if _saved_hooks else (None, lambda x: x)
+            return tuple(unpack(t) for t in self._saved)
+        return self._saved
+
+    saved_tensors = property(lambda self: self.saved_tensor())
+
+    def mark_not_inplace(self, *tensors):
+        self.not_inplace_tensors = tensors
+
+    def set_materialize_grads(self, value: bool):
+        self._materialize_grads = bool(value)
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with _ag.set_grad_enabled(False):
+            outs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outs, (tuple, list))
+        out_list = [outs] if single else list(outs)
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        need_grad = _ag.is_grad_enabled() and any(not t.stop_gradient for t in tensor_inputs)
+        if not need_grad:
+            return outs
+
+        n_out = len([o for o in out_list if isinstance(o, Tensor)])
+
+        def vjp_fn(cots):
+            if n_out == 1 or not isinstance(cots, tuple):
+                cots = (cots,)
+            grad_in = [Tensor(c, stop_gradient=True) for c in cots]
+            with _ag.set_grad_enabled(False):
+                gins = cls.backward(ctx, *grad_in)
+            if not isinstance(gins, (tuple, list)):
+                gins = (gins,)
+            # map returned grads back to positional tensor inputs
+            out = []
+            gi = iter(gins)
+            for a in args:
+                if isinstance(a, Tensor):
+                    g = next(gi, None)
+                    out.append(None if g is None else (g._data if isinstance(g, Tensor) else jnp.asarray(g)))
+                else:
+                    out.append(None)
+            return tuple(out)
+
+        specs = [(tuple(o._data.shape), o._data.dtype) for o in out_list if isinstance(o, Tensor)]
+        node = _ag.GradNode(cls.__name__, vjp_fn, list(args), n_out, specs)
+        idx = 0
+        for o in out_list:
+            if isinstance(o, Tensor) and jnp.issubdtype(o._data.dtype, jnp.inexact):
+                o.stop_gradient = False
+                o._grad_node = node
+                o._out_index = idx
+                idx += 1
+            elif isinstance(o, Tensor):
+                idx += 1
+        return outs
+
+
+LegacyPyLayer = PyLayer

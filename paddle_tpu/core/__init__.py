@@ -1,0 +1,1 @@
+from . import autograd, dtype, flags, generator, place, tensor  # noqa

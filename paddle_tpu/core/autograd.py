@@ -1,0 +1,307 @@
+"""Tape-based eager autograd engine.
+
+Reference parity: the eager GradNode DAG and backward engine —
+`GradNodeBase` (`paddle/fluid/eager/grad_node_info.h:168`), `egr::Backward` /
+`RunBackward` (`paddle/fluid/eager/backward.cc:421,:104`), in-degree computation
+(`general_grad.h:23-69`), `GradTensorHolder` accumulation, leaf accumulation
+(`accumulation/accumulation_node.h:23`), partial `paddle.grad` (`general_grad.h`).
+
+TPU-native design: instead of ~900 hand-written grad kernels, each recorded op captures
+its pullback from `jax.vjp` over the op's jnp implementation, so XLA differentiates the
+kernel while this engine owns the *graph semantics* (topological traversal, fan-in
+accumulation, retain_graph, hooks, partial `grad()`).  The jit/`to_static` path bypasses
+this tape entirely and uses `jax.grad` over the captured program.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class _TLS(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+
+
+_tls = _TLS()
+
+
+def is_grad_enabled() -> bool:
+    return _tls.grad_enabled
+
+
+def set_grad_enabled(mode: bool):
+    class _Guard:
+        def __init__(self, mode):
+            self.prev = _tls.grad_enabled
+            _tls.grad_enabled = bool(mode)
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            _tls.grad_enabled = self.prev
+
+    return _Guard(mode)
+
+
+class no_grad(contextlib.ContextDecorator):
+    """Context manager / decorator disabling grad recording (paddle.no_grad)."""
+
+    def __enter__(self):
+        self._prev = _tls.grad_enabled
+        _tls.grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _tls.grad_enabled = self._prev
+        return False
+
+
+class enable_grad(contextlib.ContextDecorator):
+    def __enter__(self):
+        self._prev = _tls.grad_enabled
+        _tls.grad_enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _tls.grad_enabled = self._prev
+        return False
+
+
+class GradNode:
+    """One recorded op in the tape (GradNodeBase parity).
+
+    Holds the vjp pullback and edges to input tensors.  Output tensors point back at
+    their producing node via (tensor._grad_node, tensor._out_index).
+    """
+
+    __slots__ = ("name", "vjp_fn", "inputs", "n_outputs", "out_specs", "out_refs",
+                 "id", "__weakref__")
+
+    _counter = 0
+
+    def __init__(self, name: str, vjp_fn: Callable, inputs: Sequence[Any], n_outputs: int,
+                 out_specs=None):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = list(inputs)  # strong refs (TensorWrapper parity)
+        self.n_outputs = n_outputs
+        self.out_specs = out_specs  # [(shape, dtype)] per output, for zero-filling
+        self.out_refs = None  # {out_index: [weakref(Tensor)]} for hooks/retain_grads
+        GradNode._counter += 1
+        self.id = GradNode._counter
+
+    def register_output_ref(self, tensor):
+        import weakref
+        if self.out_refs is None:
+            self.out_refs = {}
+        self.out_refs.setdefault(tensor._out_index, []).append(weakref.ref(tensor))
+
+    def __repr__(self):
+        return f"<GradNode {self.name}#{self.id}>"
+
+
+def _accumulate(buf: dict, idx: int, value):
+    cur = buf.get(idx)
+    buf[idx] = value if cur is None else cur + value
+
+
+def _is_float_dtype(dt) -> bool:
+    return jnp.issubdtype(dt, jnp.floating) or jnp.issubdtype(dt, jnp.complexfloating)
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def run_backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
+                 retain_graph: bool = False) -> None:
+    """Full backward from seeds, accumulating into leaf `.grad` (`RunBackward` parity)."""
+    _engine(tensors, grad_tensors, retain_graph, inputs=None, create_graph=False,
+            allow_unused=True)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,
+         only_inputs=True, allow_unused=False, no_grad_vars=None):
+    """Partial gradient (paddle.grad / `general_grad.h` parity): returns grads of
+    `outputs` w.r.t. `inputs` without writing `.grad` fields."""
+    outputs = _as_list(outputs)
+    inputs = _as_list(inputs)
+    if retain_graph is None:
+        retain_graph = create_graph
+    return _engine(outputs, grad_outputs, retain_graph, inputs=inputs,
+                   create_graph=create_graph, allow_unused=allow_unused)
+
+
+def _engine(tensors, grad_tensors, retain_graph, inputs, create_graph, allow_unused):
+    from .tensor import Tensor  # cycle: tensor builds nodes, engine consumes them
+
+    partial = inputs is not None
+    tensors = _as_list(tensors)
+    grad_tensors = _as_list(grad_tensors) or [None] * len(tensors)
+    if len(grad_tensors) != len(tensors):
+        raise ValueError("grad_tensors length must match tensors")
+
+    # pending[node] = {out_index: accumulated cotangent jnp array}
+    pending: Dict[GradNode, Dict[int, Any]] = {}
+    input_grads: Dict[int, Any] = {}  # id(input tensor) -> cotangent data
+    input_ids = {id(t): t for t in inputs} if partial else {}
+    # requested intermediate inputs, keyed by producing (node id, out_index)
+    want_from_node: Dict[tuple, List] = {}
+    if partial:
+        for t in inputs:
+            if t._grad_node is not None:
+                want_from_node.setdefault((t._grad_node, t._out_index), []).append(t)
+
+    def leaf_hit(tensor, gdata):
+        """Cotangent arrived at a graph endpoint."""
+        if partial:
+            if id(tensor) in input_ids:
+                cur = input_grads.get(id(tensor))
+                input_grads[id(tensor)] = gdata if cur is None else cur + gdata
+            return
+        for hook in tensor._backward_hooks:
+            res = hook(Tensor(gdata, stop_gradient=True))
+            if res is not None:
+                gdata = res._data if isinstance(res, Tensor) else jnp.asarray(res)
+        if tensor.grad is None:
+            g = Tensor(gdata, stop_gradient=True)
+            g.persistable = True
+            tensor.grad = g
+        else:
+            tensor.grad._data = tensor.grad._data + gdata
+
+    # ---- seeds ----
+    for t, g in zip(tensors, grad_tensors):
+        if not isinstance(t, Tensor):
+            raise TypeError("backward seeds must be Tensors")
+        if g is None:
+            if t._data.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; got shape "
+                    f"{tuple(t._data.shape)}")
+            gdata = jnp.ones(t._data.shape, t._data.dtype)
+        else:
+            gdata = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        node = t._grad_node
+        if node is None:
+            if not t.stop_gradient:
+                leaf_hit(t, gdata)
+            continue
+        _accumulate(pending.setdefault(node, {}), t._out_index, gdata)
+
+    # ---- phase 1: reachable set + in-degree over node graph (general_grad.h:23-69) ----
+    indeg: Dict[GradNode, int] = {}
+    seen = set()
+    stack = list(pending.keys())
+    while stack:
+        node = stack.pop()
+        if node in seen:
+            continue
+        seen.add(node)
+        for inp in node.inputs:
+            if isinstance(inp, Tensor) and inp._grad_node is not None:
+                nxt = inp._grad_node
+                indeg[nxt] = indeg.get(nxt, 0) + 1
+                if nxt not in seen:
+                    stack.append(nxt)
+
+    # ---- phase 2: ready-queue topo traversal ----
+    ready = [n for n in seen if indeg.get(n, 0) == 0]
+    while ready:
+        node = ready.pop()
+        bufs = pending.pop(node, None)
+        in_cots = None
+        # non-leaf hooks + retain_grads registered on this node's outputs
+        if node.out_refs and bufs:
+            for i, wrefs in node.out_refs.items():
+                c = bufs.get(i)
+                if c is None:
+                    continue
+                for wref in wrefs:
+                    t = wref()
+                    if t is None:
+                        continue
+                    for hook in t._backward_hooks:
+                        res = hook(Tensor(c, stop_gradient=True))
+                        if res is not None:
+                            c = res._data if isinstance(res, Tensor) else jnp.asarray(res)
+                    if getattr(t, "_retain_grad", False):
+                        if t.grad is None:
+                            g = Tensor(c, stop_gradient=True)
+                            g.persistable = True
+                            t.grad = g
+                        else:
+                            t.grad._data = t.grad._data + c
+                bufs[i] = c
+        if bufs:
+            # capture cotangents for requested intermediates produced by this node
+            for i in range(node.n_outputs):
+                for t in want_from_node.get((node, i), ()):  # partial-grad intermediates
+                    c = bufs.get(i)
+                    if c is not None:
+                        cur = input_grads.get(id(t))
+                        input_grads[id(t)] = c if cur is None else cur + c
+            if node.vjp_fn is None:
+                raise RuntimeError(
+                    f"Trying to run backward through {node.name} a second time. Set "
+                    "retain_graph=True on the first backward if you need this.")
+            cots = []
+            for i in range(node.n_outputs):
+                c = bufs.get(i)
+                if c is None:
+                    shape, dt = node.out_specs[i]
+                    if _is_float_dtype(jnp.dtype(dt)):
+                        c = jnp.zeros(shape, dt)
+                    else:
+                        # integer/bool outputs (e.g. topk indices): jax.vjp expects
+                        # float0 cotangents, not integer zeros
+                        c = np.zeros(shape, dtype=jax.dtypes.float0)
+                cots.append(c)
+            cot_arg = tuple(cots) if node.n_outputs > 1 else cots[0]
+            with set_grad_enabled(create_graph):
+                in_cots = node.vjp_fn(cot_arg)
+        if not retain_graph and node.vjp_fn is not None:
+            node.vjp_fn = None
+        for k, inp in enumerate(node.inputs):
+            if not isinstance(inp, Tensor):
+                continue
+            ic = None
+            if in_cots is not None:
+                ic = in_cots[k]
+                if ic is not None and not _is_float_dtype(jnp.asarray(ic).dtype):
+                    ic = None  # int/bool primal: float0 cotangent, nothing to propagate
+            nxt = inp._grad_node
+            if nxt is not None:
+                if ic is not None:
+                    _accumulate(pending.setdefault(nxt, {}), inp._out_index, ic)
+                indeg[nxt] -= 1
+                if indeg[nxt] == 0:
+                    ready.append(nxt)  # fires even with no cotangent (zero-pass skip)
+            elif ic is not None and not inp.stop_gradient:
+                leaf_hit(inp, ic)
+
+    if not partial:
+        return None
+    out = []
+    for t in inputs:
+        g = input_grads.get(id(t))
+        if g is None:
+            if not allow_unused:
+                raise ValueError(
+                    "one of the input tensors was not used in the graph; set "
+                    "allow_unused=True to return None for it")
+            out.append(None)
+        else:
+            out.append(Tensor(g, stop_gradient=not create_graph))
+    return out

@@ -1,0 +1,125 @@
+"""Dtype system.
+
+Reference parity: phi DataType enum (`paddle/phi/common/data_type.h`) exposed as
+``paddle.float32`` etc.  Here dtypes are thin singletons wrapping numpy/jnp dtypes so they
+interoperate directly with XLA; string forms ("float32") are accepted everywhere.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import ml_dtypes  # ships with jax
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+    _FP8_E4M3 = np.dtype(ml_dtypes.float8_e4m3fn)
+    _FP8_E5M2 = np.dtype(ml_dtypes.float8_e5m2)
+except Exception:  # pragma: no cover
+    _BF16 = np.dtype("float32")
+    _FP8_E4M3 = None
+    _FP8_E5M2 = None
+
+
+class DType:
+    """A framework dtype: hashable, comparable with strings and numpy dtypes."""
+
+    __slots__ = ("name", "np_dtype")
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        if isinstance(other, str):
+            return self.name == other or str(self.np_dtype) == other
+        try:
+            return self.np_dtype == np.dtype(other)
+        except Exception:
+            return NotImplemented
+
+    def __hash__(self):
+        return hash(self.name)
+
+    @property
+    def itemsize(self):
+        return self.np_dtype.itemsize
+
+    def is_floating_point(self):
+        return self.name in ("float16", "bfloat16", "float32", "float64", "float8_e4m3fn", "float8_e5m2")
+
+    def is_integer(self):
+        return self.name in ("int8", "int16", "int32", "int64", "uint8", "uint16", "uint32", "uint64")
+
+    def is_complex(self):
+        return self.name in ("complex64", "complex128")
+
+
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", _BF16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+if _FP8_E4M3 is not None:
+    float8_e4m3fn = DType("float8_e4m3fn", _FP8_E4M3)
+    float8_e5m2 = DType("float8_e5m2", _FP8_E5M2)
+
+_ALL = [bool_, uint8, int8, int16, int32, int64, float16, bfloat16, float32, float64,
+        complex64, complex128]
+_BY_NAME = {d.name: d for d in _ALL}
+_BY_NAME["bool"] = bool_
+_BY_NAME["float"] = float32
+_BY_NAME["double"] = float64
+_BY_NAME["half"] = float16
+_BY_NAME["int"] = int32
+_BY_NAME["long"] = int64
+
+
+def convert_dtype(dtype) -> DType:
+    """Normalise any dtype spec (DType, str, numpy dtype, jnp dtype) to a DType."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, DType):
+        return dtype
+    if isinstance(dtype, str):
+        if dtype in _BY_NAME:
+            return _BY_NAME[dtype]
+        raise ValueError(f"unknown dtype string {dtype!r}")
+    npd = np.dtype(dtype)
+    for d in _ALL:
+        if d.np_dtype == npd:
+            return d
+    raise ValueError(f"unsupported dtype {dtype!r}")
+
+
+def to_np(dtype):
+    """DType/str/np dtype -> numpy dtype usable by jnp."""
+    d = convert_dtype(dtype)
+    return d.np_dtype if d is not None else None
+
+
+# default dtype machinery (paddle.set_default_dtype)
+_default_dtype = float32
+
+
+def set_default_dtype(dtype):
+    global _default_dtype
+    d = convert_dtype(dtype)
+    if not d.is_floating_point():
+        raise TypeError("default dtype must be floating point, got %s" % d)
+    _default_dtype = d
+
+
+def get_default_dtype() -> str:
+    return _default_dtype.name

@@ -1,0 +1,105 @@
+"""RNG state.
+
+Reference parity: `phi::Generator` (`paddle/phi/core/generator.h`) — per-device seeded
+Philox state — and the fleet `RNGStatesTracker` (`fleet/layers/mpu/random.py`).  JAX's
+threefry key IS the Philox-analog counter state; we keep a mutable default generator that
+splits a fresh key per draw so eager random ops are stateful like the reference, while
+`rng_state()`/`set_state` expose the raw key for capture inside jit.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import numpy as np
+
+
+class Generator:
+    """Stateful RNG built on splitting a jax PRNG key."""
+
+    def __init__(self, seed: int = 0):
+        self._seed = int(seed)
+        self._key = jax.random.key(self._seed)
+        self._lock = threading.Lock()
+
+    def manual_seed(self, seed: int) -> "Generator":
+        self._seed = int(seed)
+        self._key = jax.random.key(self._seed)
+        return self
+
+    seed = manual_seed
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        """Split and return a fresh subkey (advances state)."""
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def get_state(self):
+        return self._key
+
+    def set_state(self, key):
+        self._key = key
+
+
+_default = Generator(np.random.randint(0, 2**31 - 1))
+
+
+def default_generator() -> Generator:
+    return _default
+
+
+def seed(s: int) -> Generator:
+    """paddle.seed — seeds the default (and tracker) generators."""
+    _default.manual_seed(s)
+    _tracker.reset(s)
+    return _default
+
+
+def next_key():
+    return _default.next_key()
+
+
+class RNGStatesTracker:
+    """Named parallel RNG states (fleet/layers/mpu/random.py parity).
+
+    Model-parallel dropout needs different streams on different TP ranks for activation
+    dropout but identical streams for weight init; named states provide both.
+    """
+
+    def __init__(self):
+        self._states = {}
+
+    def reset(self, base_seed=None):
+        self._states = {}
+
+    def add(self, name: str, seed: int):
+        if name in self._states:
+            raise ValueError(f"rng state {name!r} already exists")
+        self._states[name] = Generator(seed)
+
+    def get_states_tracker(self):
+        return dict(self._states)
+
+    def set_states_tracker(self, states):
+        self._states = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "global_seed"):
+        if name == "global_seed" and name not in self._states:
+            yield _default
+            return
+        if name not in self._states:
+            raise ValueError(f"rng state {name!r} not added")
+        yield self._states[name]
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _tracker

@@ -1,0 +1,20 @@
+"""paddle.distributed parity surface (TPU-native: XLA collectives + GSPMD meshes)."""
+from .parallel_env import ParallelEnv, get_rank, get_world_size, init_parallel_env  # noqa
+from .communication import (Group, ReduceOp, all_gather, all_gather_object,  # noqa
+                            all_reduce, alltoall, alltoall_single, barrier,
+                            broadcast, broadcast_object_list, destroy_process_group,
+                            gather, get_backend, get_group, irecv, is_initialized,
+                            isend, new_group, recv, reduce, reduce_scatter, scatter,
+                            scatter_object_list, send, wait, P2POp, batch_isend_irecv,
+                            stream)
+from .parallel import DataParallel  # noqa
+from . import fleet  # noqa
+from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa
+from . import auto_parallel  # noqa
+from .auto_parallel.api import shard_tensor, shard_op, dtensor_from_fn, reshard  # noqa
+from .auto_parallel.process_mesh import ProcessMesh  # noqa
+from .spawn import spawn  # noqa
+
+
+def is_available():
+    return True

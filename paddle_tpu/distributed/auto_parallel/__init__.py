@@ -1,0 +1,3 @@
+from . import api  # noqa
+from .api import dtensor_from_fn, reshard, shard_op, shard_tensor  # noqa
+from .process_mesh import ProcessMesh  # noqa

@@ -1,0 +1,82 @@
+"""Semi-auto SPMD API (reference: `python/paddle/distributed/auto_parallel/` —
+shard_tensor interface, dist_attr; `Engine` lives in `engine.py`).
+
+TPU-native: `shard_tensor(x, mesh, placements)` device_puts the array with a
+NamedSharding — from then on every jitted computation over it is partitioned by GSPMD,
+which performs the reference's completion (sharding propagation), partitioning (SPMD
+split), and resharding (collective insertion) inside XLA.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from .process_mesh import ProcessMesh
+
+
+class Placement:
+    pass
+
+
+class Replicate(Placement):
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Shard(Placement):
+    def __init__(self, dim):
+        self.dim = dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Partial(Placement):
+    def __repr__(self):
+        return "Partial()"
+
+
+def _to_partition_spec(placements, mesh: ProcessMesh, ndim):
+    from jax.sharding import PartitionSpec as P
+    spec = [None] * ndim
+    for axis_idx, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            spec[pl.dim] = mesh.dim_names[axis_idx]
+    return P(*spec)
+
+
+def shard_tensor(x, mesh: ProcessMesh, placements, dtype=None, place=None,
+                 stop_gradient=None):
+    """Place a tensor onto the mesh with the given placements."""
+    from jax.sharding import NamedSharding
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    jmesh = mesh.jax_mesh()
+    spec = _to_partition_spec(placements, mesh, t._data.ndim)
+    sharded = jax.device_put(t._data, NamedSharding(jmesh, spec))
+    out = Tensor(sharded, stop_gradient=t.stop_gradient
+                 if stop_gradient is None else stop_gradient)
+    out._dist_mesh = mesh
+    out._dist_placements = placements
+    return out
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(x, mesh, placements):
+    return shard_tensor(x, mesh, placements)
+
+
+def shard_op(op, mesh=None, in_placements=None, out_placements=None):
+    """Annotate an op call with shardings via with_sharding_constraint."""
+    def wrapper(*args, **kwargs):
+        out = op(*args, **kwargs)
+        if mesh is not None and out_placements is not None and isinstance(out, Tensor):
+            from jax.sharding import NamedSharding
+            spec = _to_partition_spec(out_placements, mesh, out._data.ndim)
+            out._data = jax.lax.with_sharding_constraint(
+                out._data, NamedSharding(mesh.jax_mesh(), spec))
+        return out
+    return wrapper

@@ -1,0 +1,56 @@
+"""ProcessMesh (reference: `phi/core/distributed/auto_parallel/process_mesh.h`,
+`python/paddle/distributed/auto_parallel/process_mesh.py`).
+
+TPU-native: a ProcessMesh IS a `jax.sharding.Mesh` — `jax_mesh()` returns it; shard
+specs map to PartitionSpecs and GSPMD does completion/partitioning (the reference's
+Completer/Partitioner/Resharder pipeline collapses into XLA sharding propagation).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names=None, process_ids=None):
+        arr = np.asarray(mesh)
+        self._shape = list(arr.shape)
+        self._process_ids = arr.flatten().tolist()
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self._dim_names = list(dim_names)
+        self._jax_mesh = None
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def process_ids(self):
+        return self._process_ids
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    def get_dim_size(self, dim_name):
+        return self._shape[self._dim_names.index(dim_name)]
+
+    def jax_mesh(self):
+        if self._jax_mesh is None:
+            import jax
+            from jax.sharding import Mesh
+            devs = np.asarray(jax.devices())[np.asarray(self._process_ids)] \
+                .reshape(self._shape)
+            self._jax_mesh = Mesh(devs, tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh) and self._shape == other._shape
+                and self._process_ids == other._process_ids)
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self._shape}, dim_names={self._dim_names})"

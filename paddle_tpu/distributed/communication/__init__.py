@@ -1,0 +1,7 @@
+from .group import (Group, barrier, destroy_process_group, get_backend, get_group,  # noqa
+                    is_initialized, new_group, wait)
+from .ops import (all_gather, all_gather_object, all_reduce, alltoall,  # noqa
+                  alltoall_single, broadcast, broadcast_object_list, gather,
+                  irecv, isend, recv, reduce, reduce_scatter, scatter,
+                  scatter_object_list, send, ReduceOp, P2POp, batch_isend_irecv)
+from . import stream  # noqa

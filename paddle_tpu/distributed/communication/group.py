@@ -1,0 +1,133 @@
+"""Process groups (reference: `python/paddle/distributed/communication/group.py:22`,
+`collective.py:175` `new_group`).
+
+A Group is a named set of global ranks.  On TPU there is no per-group NCCL
+communicator to build — a group materializes as a mesh axis for XLA collectives; eager
+collectives route through `communication.all_reduce` etc., which pick the jit'd
+collective over the group's device set.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class Group:
+    def __init__(self, rank_in_group: int, gid: int, ranks: List[int], name=None):
+        self._rank_in_group = rank_in_group
+        self._id = gid
+        self._ranks = list(ranks)
+        self._name = name or f"group_{gid}"
+
+    @property
+    def rank(self):
+        return self._rank_in_group
+
+    @property
+    def ranks(self):
+        return self._ranks
+
+    @property
+    def nranks(self):
+        return len(self._ranks)
+
+    world_size = nranks
+
+    @property
+    def id(self):
+        return self._id
+
+    @property
+    def name(self):
+        return self._name
+
+    def is_member(self):
+        return self._rank_in_group >= 0
+
+    def get_group_rank(self, global_rank):
+        return self._ranks.index(global_rank) if global_rank in self._ranks else -1
+
+    def __repr__(self):
+        return f"Group(id={self._id}, ranks={self._ranks}, rank={self._rank_in_group})"
+
+    # Task-style handle compat: eager collectives are synchronous under XLA's async
+    # runtime (dispatch is async, completion on use) so wait() is a no-op.
+    def process_group(self):
+        return self
+
+
+_group_map = {}
+_group_counter = 0
+_default_group: Optional[Group] = None
+
+
+def _init_default_group(env):
+    global _default_group, _group_counter
+    ranks = list(range(env.world_size))
+    _default_group = Group(env.rank, 0, ranks, "default")
+    _group_map[0] = _default_group
+    _group_counter = 0
+    return _default_group
+
+
+def _get_global_group() -> Group:
+    global _default_group
+    if _default_group is None:
+        from ..parallel_env import ParallelEnv
+        _init_default_group(ParallelEnv())
+    return _default_group
+
+
+def _get_or_throw_group_rank(rank, group):
+    return group.get_group_rank(rank)
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    """reference `collective.py:175`."""
+    global _group_counter
+    from ..parallel_env import ParallelEnv
+    env = ParallelEnv()
+    if ranks is None:
+        ranks = list(range(env.world_size))
+    _group_counter += 1
+    gid = _group_counter
+    rank_in_group = ranks.index(env.rank) if env.rank in ranks else -1
+    g = Group(rank_in_group, gid, sorted(ranks))
+    _group_map[gid] = g
+    return g
+
+
+def get_group(gid=0):
+    return _group_map.get(gid)
+
+
+def is_initialized():
+    from .. import parallel_env
+    return parallel_env._is_initialized()
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    if group is None:
+        _group_map.clear()
+        _default_group = None
+    else:
+        _group_map.pop(group.id, None)
+
+
+def get_backend(group=None):
+    return "XLA"
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    # XLA runtime orders collectives on the stream; block for explicit sync
+    import jax
+    if hasattr(tensor, "_data"):
+        jax.block_until_ready(tensor._data)
+
+
+def barrier(group=None):
+    from .all_reduce import all_reduce
+    from ...ops.creation import ones
+    t = ones([1], "float32")
+    all_reduce(t, group=group)
+    wait(t)

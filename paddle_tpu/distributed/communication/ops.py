@@ -1,0 +1,288 @@
+"""Eager collective API (reference: `python/paddle/distributed/communication/` — 14
+modules; C++ `ProcessGroup` `fluid/distributed/collective/process_group.h:53`).
+
+TPU-native: a collective over a Group executes as a jitted XLA collective over a 1-D
+device mesh spanning the group's ranks (one device per rank, ICI/DCN routed by XLA) —
+the ProcessGroupNCCL/comm-stream machinery has no analog because the XLA runtime owns
+scheduling.  With world_size==1 every collective degrades to its identity semantics,
+matching the reference.  In-jit code should prefer mesh-sharded programs (GSPMD) over
+these eager calls; this API exists for the imperative surface (DataParallel hooks,
+barriers, object exchange).
+"""
+from __future__ import annotations
+
+import pickle
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from .group import Group, _get_global_group
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class _Task:
+    """Completed-task handle (ProcessGroup Task parity; XLA dispatch is async under
+    the hood, completion happens on first use of the result)."""
+
+    def __init__(self, tensors=None):
+        self._tensors = tensors or []
+
+    def wait(self):
+        for t in self._tensors:
+            if isinstance(t, Tensor):
+                jax.block_until_ready(t._data)
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def _group(group) -> Group:
+    return group if group is not None else _get_global_group()
+
+
+def _multiproc() -> bool:
+    return jax.process_count() > 1
+
+
+def _group_mesh(group: Group):
+    """1-D mesh with one device per group rank (first addressable device of each
+    process)."""
+    per_proc = {}
+    for d in jax.devices():
+        per_proc.setdefault(d.process_index, d)
+    devs = [per_proc[r] for r in group.ranks]
+    from jax.sharding import Mesh
+    return Mesh(np.array(devs), ("x",))
+
+
+def _to_global(x_data, group: Group):
+    """Assemble a [nranks, ...] global array from each process's local contribution."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = _group_mesh(group)
+    sharding = NamedSharding(mesh, P("x"))
+    local_dev = jax.local_devices()[0]
+    local = jax.device_put(x_data[None], local_dev)
+    shape = (group.nranks,) + tuple(x_data.shape)
+    return jax.make_array_from_single_device_arrays(shape, sharding, [local]), mesh
+
+
+def _from_global(garr):
+    shards = [s for s in garr.addressable_shards]
+    return shards[0].data[0]
+
+
+def _reduce_fn(op):
+    return {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max, ReduceOp.MIN: jnp.min,
+            ReduceOp.PROD: jnp.prod, ReduceOp.AVG: jnp.mean}[op]
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    g = _group(group)
+    if g.nranks <= 1:
+        return _Task([tensor])
+    if not _multiproc():
+        raise RuntimeError(
+            "eager all_reduce across ranks needs jax.distributed (launch via "
+            "paddle_tpu.distributed.launch); inside jit use mesh sharding instead")
+    garr, mesh = _to_global(tensor._data, g)
+    red = _reduce_fn(op)
+    out = jax.jit(lambda a: red(a, axis=0))(garr)
+    # result is replicated; take local copy
+    tensor._data = np.asarray(out) * 1  # device-local materialization
+    tensor._data = jnp.asarray(tensor._data)
+    return _Task([tensor])
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    g = _group(group)
+    if g.nranks <= 1:
+        tensor_list.append(Tensor(tensor._data))
+        return _Task(tensor_list)
+    if not _multiproc():
+        raise RuntimeError("eager all_gather needs jax.distributed")
+    garr, mesh = _to_global(tensor._data, g)
+    out = jax.jit(lambda a: a)(garr)
+    full = np.asarray(out)
+    for i in range(g.nranks):
+        tensor_list.append(Tensor(jnp.asarray(full[i])))
+    return _Task(tensor_list)
+
+
+def all_gather_object(object_list, obj, group=None):
+    g = _group(group)
+    if g.nranks <= 1:
+        object_list.append(obj)
+        return
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    sizes = []
+    size_t = Tensor(jnp.asarray([payload.size], jnp.int64))
+    size_list: List[Tensor] = []
+    all_gather(size_list, size_t, group)
+    maxlen = int(max(int(s._data[0]) for s in size_list))
+    padded = np.zeros(maxlen, np.uint8)
+    padded[:payload.size] = payload
+    data_list: List[Tensor] = []
+    all_gather(data_list, Tensor(jnp.asarray(padded)), group)
+    for s, d in zip(size_list, data_list):
+        n = int(s._data[0])
+        object_list.append(pickle.loads(bytes(np.asarray(d._data)[:n])))
+
+
+def broadcast(tensor, src, group=None, sync_op=True):
+    g = _group(group)
+    if g.nranks <= 1:
+        return _Task([tensor])
+    if not _multiproc():
+        raise RuntimeError("eager broadcast needs jax.distributed")
+    src_in_group = g.get_group_rank(src) if src in g.ranks else src
+    gathered: List[Tensor] = []
+    all_gather(gathered, tensor, group)
+    tensor._data = gathered[src_in_group]._data
+    return _Task([tensor])
+
+
+def broadcast_object_list(object_list, src, group=None):
+    g = _group(group)
+    if g.nranks <= 1:
+        return
+    gathered: List = []
+    all_gather_object(gathered, object_list, group)
+    src_in_group = g.get_group_rank(src) if src in g.ranks else src
+    object_list[:] = gathered[src_in_group]
+
+
+def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
+    g = _group(group)
+    if g.nranks <= 1:
+        return _Task([tensor])
+    all_reduce(tensor, op, group)
+    # non-dst ranks keep the reduced value too (superset of reference semantics)
+    return _Task([tensor])
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
+    g = _group(group)
+    if g.nranks <= 1:
+        src = tensor_list[0] if isinstance(tensor_list, (list, tuple)) else tensor_list
+        tensor._data = src._data
+        return _Task([tensor])
+    stacked = Tensor(jnp.stack([t._data for t in tensor_list]))
+    all_reduce(stacked, op, group)
+    tensor._data = stacked._data[g.rank]
+    return _Task([tensor])
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    g = _group(group)
+    if g.nranks <= 1:
+        if tensor_list:
+            tensor._data = tensor_list[0]._data
+        return _Task([tensor])
+    gathered: List[Tensor] = []
+    payload = Tensor(jnp.stack([t._data for t in tensor_list])) if tensor_list \
+        else Tensor(jnp.zeros((g.nranks,) + tuple(tensor._data.shape), tensor._data.dtype))
+    all_gather(gathered, payload, group)
+    src_in_group = g.get_group_rank(src) if src in g.ranks else src
+    tensor._data = gathered[src_in_group]._data[g.rank]
+    return _Task([tensor])
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0, group=None):
+    g = _group(group)
+    if g.nranks <= 1:
+        out_object_list[:] = [in_object_list[0]] if in_object_list else []
+        return
+    gathered: List = []
+    all_gather_object(gathered, in_object_list or [], group)
+    src_in_group = g.get_group_rank(src) if src in g.ranks else src
+    out_object_list[:] = [gathered[src_in_group][g.rank]]
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    g = _group(group)
+    if g.nranks <= 1:
+        if gather_list is not None:
+            gather_list.append(Tensor(tensor._data))
+        return _Task([])
+    tmp: List[Tensor] = []
+    all_gather(tmp, tensor, group)
+    if gather_list is not None:
+        gather_list.extend(tmp)
+    return _Task(tmp)
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    g = _group(group)
+    if g.nranks <= 1:
+        out_tensor_list.extend(Tensor(t._data) for t in in_tensor_list)
+        return _Task(out_tensor_list)
+    stacked = Tensor(jnp.stack([t._data for t in in_tensor_list]))
+    gathered: List[Tensor] = []
+    all_gather(gathered, stacked, group)  # [ranks][ranks, ...]
+    for r in range(g.nranks):
+        out_tensor_list.append(Tensor(gathered[r]._data[g.rank]))
+    return _Task(out_tensor_list)
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=None,
+                    group=None, sync_op=True):
+    g = _group(group)
+    if g.nranks <= 1:
+        out_tensor._data = in_tensor._data
+        return _Task([out_tensor])
+    ins = list(jnp.split(in_tensor._data, g.nranks, axis=0))
+    outs: List[Tensor] = []
+    alltoall(outs, [Tensor(t) for t in ins], group)
+    out_tensor._data = jnp.concatenate([t._data for t in outs], axis=0)
+    return _Task([out_tensor])
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    g = _group(group)
+    if g.nranks <= 1:
+        return _Task([])
+    raise NotImplementedError(
+        "eager p2p send: TPU p2p lives inside compiled programs (ppermute under "
+        "shard_map — see paddle_tpu.distributed.fleet pipeline_parallel); the eager "
+        "path intentionally has no NCCL-style stream send")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    g = _group(group)
+    if g.nranks <= 1:
+        return _Task([tensor])
+    raise NotImplementedError("eager p2p recv: see send()")
+
+
+def isend(tensor, dst, group=None):
+    return send(tensor, dst, group, sync_op=False)
+
+
+def irecv(tensor, src=None, group=None):
+    return recv(tensor, src, group, sync_op=False)
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    tasks = []
+    for op in p2p_op_list:
+        tasks.append(op.op(op.tensor, op.peer, op.group))
+    return tasks

@@ -1,0 +1,8 @@
+"""stream.* collective variants (reference:
+`python/paddle/distributed/communication/stream/`).
+
+The use_calc_stream distinction is meaningless under the XLA runtime (it owns stream
+scheduling), so these delegate to the standard collectives, keeping the API surface.
+"""
+from .ops import (all_gather, all_reduce, alltoall, alltoall_single, broadcast,  # noqa
+                  gather, recv, reduce, reduce_scatter, scatter, send)

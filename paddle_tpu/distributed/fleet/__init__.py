@@ -1,0 +1,117 @@
+"""Fleet facade (reference: `fleet/fleet.py:99` — init, distributed_model,
+distributed_optimizer)."""
+from __future__ import annotations
+
+from .topology import (CommunicateTopology, HybridCommunicateGroup, ParallelMode,
+                       _get_hybrid_group)
+from .distributed_strategy import DistributedStrategy
+from . import topology as _topo_mod
+from ..parallel_env import ParallelEnv, init_parallel_env
+from . import recompute as _recompute_mod
+from .recompute import recompute, recompute_sequential  # noqa
+from .utils import sequence_parallel_utils  # noqa
+from .layers import mpu  # noqa
+from .meta_parallel import (PipelineLayer, LayerDesc, SharedLayerDesc,  # noqa
+                            PipelineParallel, TensorParallel)
+from .meta_optimizers import HybridParallelOptimizer, HybridParallelGradScaler  # noqa
+
+
+class _FleetState:
+    def __init__(self):
+        self.strategy = None
+        self.hcg = None
+        self.initialized = False
+
+
+_state = _FleetState()
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+    """fleet.init (reference `fleet/fleet.py:169`): bring up env + hybrid topology."""
+    init_parallel_env()
+    _state.strategy = strategy or DistributedStrategy()
+    hybrid = _state.strategy.hybrid_configs
+    env = ParallelEnv()
+    dp = hybrid.get("dp_degree", 1)
+    mp = hybrid.get("mp_degree", 1)
+    pp = hybrid.get("pp_degree", 1)
+    sharding = hybrid.get("sharding_degree", 1)
+    sep = hybrid.get("sep_degree", 1)
+    world = env.world_size
+    # auto-fill dp like the reference
+    known = mp * pp * sharding * sep
+    if dp * known != world and world % known == 0:
+        dp = world // known
+    topo = CommunicateTopology(["data", "pipe", "sharding", "sep", "model"],
+                               [dp, pp, sharding, sep, mp])
+    _state.hcg = HybridCommunicateGroup(topo)
+    _topo_mod._HYBRID_PARALLEL_GROUP = _state.hcg
+    _state.initialized = True
+    return None
+
+
+def is_initialized():
+    return _state.initialized
+
+
+def get_hybrid_communicate_group():
+    return _state.hcg
+
+
+def worker_index():
+    return ParallelEnv().rank
+
+
+def worker_num():
+    return ParallelEnv().world_size
+
+
+def is_first_worker():
+    return worker_index() == 0
+
+
+def barrier_worker():
+    from ..communication.group import barrier
+    barrier()
+
+
+def distributed_model(model):
+    """Wrap per parallel mode (reference `fleet/model.py`)."""
+    from ..parallel import DataParallel
+    if _state.hcg is None:
+        init()
+    hcg = _state.hcg
+    mode = hcg.get_parallel_mode()
+    if mode == ParallelMode.PIPELINE_PARALLEL:
+        return PipelineParallel(model, hcg, _state.strategy)
+    if mode == ParallelMode.TENSOR_PARALLEL:
+        return TensorParallel(model, hcg, _state.strategy)
+    if hcg.get_data_parallel_world_size() > 1:
+        return DataParallel(model, group=hcg.get_data_parallel_group())
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """Wrap optimizer for hybrid runs (reference `fleet/optimizer.py`)."""
+    if _state.hcg is None:
+        init(strategy=strategy)
+    hcg = _state.hcg
+    if hcg.get_mesh().size > 1 or hcg.get_model_parallel_world_size() > 1 \
+            or hcg.get_pipe_parallel_world_size() > 1 \
+            or hcg.get_sharding_parallel_world_size() > 1:
+        return HybridParallelOptimizer(optimizer, hcg, _state.strategy)
+    return optimizer
+
+
+def distributed_scaler(scaler):
+    return HybridParallelGradScaler(scaler, _state.hcg)
+
+
+class UserDefinedRoleMaker:
+    def __init__(self, *a, **kw):
+        pass
+
+
+class PaddleCloudRoleMaker:
+    def __init__(self, is_collective=False, **kw):
+        self.is_collective = is_collective

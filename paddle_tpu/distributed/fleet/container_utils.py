@@ -1,0 +1,2 @@
+def build_desc_layer(desc):
+    return desc.build_layer()

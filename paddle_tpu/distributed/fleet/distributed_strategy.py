@@ -1,0 +1,60 @@
+"""DistributedStrategy (reference: `fleet/base/distributed_strategy.py:121`, proto
+`fluid/framework/distributed_strategy.proto`).
+
+Plain-attribute config object covering the reference's strategy surface; consumed by
+fleet.init / distributed_model / distributed_optimizer.
+"""
+from __future__ import annotations
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # hybrid parallel dims (reference hybrid_configs)
+        self.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+                               "sharding_degree": 1, "sep_degree": 1,
+                               "mp_configs": {}, "pp_configs": {}}
+        # amp
+        self.amp = False
+        self.amp_configs = {"init_loss_scaling": 32768.0, "custom_white_list": [],
+                            "custom_black_list": [], "use_pure_fp16": False,
+                            "use_fp16_guard": True, "use_bf16": True}
+        # recompute
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": [], "enable_offload": False}
+        # sharding (ZeRO)
+        self.sharding = False
+        self.sharding_configs = {"sharding_degree": 1, "stage": 1, "offload": False,
+                                 "accumulate_steps": 1}
+        # pipeline
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1,
+                                 "schedule_mode": "1F1B"}
+        # tensor parallel (static-graph era config, kept for parity)
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
+        # misc meta-optimizer knobs (accepted; most are no-ops on TPU)
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.localsgd = False
+        self.fp16_allreduce = False
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.gradient_scale_configs = {"scale_strategy": "avg"}
+        self.heter_ccl_mode = False
+        self.is_fl_ps_mode = False
+        self.a_sync = False
+        self.a_sync_configs = {}
+        self.auto = False
+        self.semi_auto = False
+        self.auto_search = False
+        self.without_graph_optimization = True
+
+    def __repr__(self):
+        keys = ["hybrid_configs", "amp", "recompute", "sharding", "pipeline"]
+        return "DistributedStrategy(" + ", ".join(
+            f"{k}={getattr(self, k)!r}" for k in keys) + ")"

@@ -1,0 +1,245 @@
+"""Tensor/model-parallel layers (reference: `fleet/layers/mpu/mp_layers.py` —
+`VocabParallelEmbedding` :35, `ColumnParallelLinear` :173, `RowParallelLinear` :343,
+`ParallelCrossEntropy` :524; comm prims `mp_ops.py`).
+
+TPU-native: each layer holds its LOCAL weight shard (reference semantics) and also
+stamps `param._dist_axes` with the mesh PartitionSpec so the jit path can hand XLA the
+global view (GSPMD inserts the same collectives the reference codes by hand).  The
+eager collectives route through communication.ops, identity at world 1.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....core import autograd as _ag
+from ....core.tensor import Tensor
+from ....nn import functional as F
+from ....nn.initializer import Constant, XavierNormal
+from ....nn.layer.layers import Layer
+from ...communication.ops import ReduceOp, all_gather, all_reduce
+from ..topology import _get_hybrid_group
+
+
+def _mp_info():
+    hcg = _get_hybrid_group()
+    if hcg is None:
+        return 1, 0, None
+    return (hcg.get_model_parallel_world_size(), hcg.get_model_parallel_rank(),
+            hcg.get_model_parallel_group())
+
+
+# ---- mp_ops (reference fleet/layers/mpu/mp_ops.py) ----
+
+def _c_identity(x, group=None):
+    """Forward identity, backward allreduce (reference `_c_identity`)."""
+    world, _, g = _mp_info()
+    if world <= 1:
+        return x
+
+    def vjp_fn(cot):
+        t = Tensor(cot, stop_gradient=True)
+        all_reduce(t, ReduceOp.SUM, group=g)
+        return (t._data,)
+    node = _ag.GradNode("c_identity", vjp_fn, [x], 1,
+                        [(tuple(x._data.shape), x._data.dtype)])
+    out = Tensor(x._data)
+    if not x.stop_gradient and _ag.is_grad_enabled():
+        out.stop_gradient = False
+        out._grad_node = node
+    return out
+
+
+def _mp_allreduce(x, group=None):
+    """Forward allreduce, backward identity (reference `_mp_allreduce`)."""
+    world, _, g = _mp_info()
+    if world <= 1:
+        return x
+    t = Tensor(x._data)
+    all_reduce(t, ReduceOp.SUM, group=g)
+
+    def vjp_fn(cot):
+        return (cot,)
+    node = _ag.GradNode("mp_allreduce_sum", vjp_fn, [x], 1,
+                        [(tuple(t._data.shape), t._data.dtype)])
+    if not x.stop_gradient and _ag.is_grad_enabled():
+        t.stop_gradient = False
+        t._grad_node = node
+    return t
+
+
+def _c_concat(x, group=None):
+    """Gather along last dim across mp ranks (reference `_c_concat`)."""
+    world, rank, g = _mp_info()
+    if world <= 1:
+        return x
+    parts = []
+    all_gather(parts, x, group=g)
+    out_data = jnp.concatenate([p._data for p in parts], axis=-1)
+
+    def vjp_fn(cot):
+        piece = jnp.split(cot, world, axis=-1)[rank]
+        return (piece,)
+    node = _ag.GradNode("c_concat", vjp_fn, [x], 1, [(tuple(out_data.shape),
+                                                      out_data.dtype)])
+    out = Tensor(out_data)
+    if not x.stop_gradient and _ag.is_grad_enabled():
+        out.stop_gradient = False
+        out._grad_node = node
+    return out
+
+
+def _c_split(x, group=None):
+    """Keep this rank's slice of the last dim (reference `_c_split`)."""
+    world, rank, g = _mp_info()
+    if world <= 1:
+        return x
+    from ....ops.manipulation import split
+    return split(x, world, axis=-1)[rank]
+
+
+class VocabParallelEmbedding(Layer):
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None, mp_group=None,
+                 name=None):
+        super().__init__()
+        world, rank, _ = _mp_info()
+        self.world_size = world
+        self.rank = rank
+        self.origin_num_embeddings = num_embeddings
+        assert num_embeddings % world == 0
+        per = num_embeddings // world
+        self.vocab_start_index = rank * per
+        self._per_part_size = per
+        self.weight = self.create_parameter(
+            shape=[per, embedding_dim], attr=weight_attr,
+            default_initializer=XavierNormal())
+        self.weight.is_distributed = world > 1
+        self.weight._dist_axes = ("mp", None)  # vocab dim sharded over mp
+
+    def forward(self, x):
+        if self.world_size <= 1:
+            return F.embedding(x, self.weight)
+        # mask out-of-shard ids, embed, allreduce partial sums
+        from ....core.tensor import apply
+        start = self.vocab_start_index
+        per = self._per_part_size
+
+        def f(ids, w):
+            local = ids - start
+            in_range = (local >= 0) & (local < per)
+            safe = jnp.where(in_range, local, 0)
+            emb = jnp.take(w, safe.astype(jnp.int32), axis=0)
+            return jnp.where(in_range[..., None], emb, 0.0)
+        out = apply("vocab_parallel_embedding", f, x, self.weight)
+        return _mp_allreduce(out)
+
+
+class ColumnParallelLinear(Layer):
+    """W [in, out/world]; forward identity-in, optional gather-out."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=None,
+                 gather_output=True, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        world, rank, _ = _mp_info()
+        self.world_size = world
+        assert out_features % world == 0
+        self.out_per_part = out_features // world
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            shape=[in_features, self.out_per_part], attr=weight_attr,
+            default_initializer=XavierNormal())
+        self.weight.is_distributed = world > 1
+        self.weight._dist_axes = (None, "mp")
+        if has_bias:
+            self.bias = self.create_parameter(
+                shape=[self.out_per_part], attr=None, is_bias=True)
+            self.bias.is_distributed = world > 1
+            self.bias._dist_axes = ("mp",)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        x = _c_identity(x)
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            out = _c_concat(out)
+        return out
+
+
+class RowParallelLinear(Layer):
+    """W [in/world, out]; input either already split or split here; allreduce out."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=False, fuse_matmul_bias=False, mp_group=None,
+                 name=None):
+        super().__init__()
+        world, rank, _ = _mp_info()
+        self.world_size = world
+        assert in_features % world == 0
+        self.in_per_part = in_features // world
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            shape=[self.in_per_part, out_features], attr=weight_attr,
+            default_initializer=XavierNormal())
+        self.weight.is_distributed = world > 1
+        self.weight._dist_axes = ("mp", None)
+        if has_bias:
+            self.bias = self.create_parameter(shape=[out_features], attr=None,
+                                              is_bias=True)
+            self.bias._dist_axes = (None,)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if not self.input_is_parallel:
+            x = _c_split(x)
+        out = F.linear(x, self.weight, None)
+        out = _mp_allreduce(out)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class ParallelCrossEntropy(Layer):
+    """TP-parallel softmax CE over the vocab-sharded logits (reference
+    `c_softmax_with_cross_entropy`)."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        world, rank, g = _mp_info()
+        if world <= 1:
+            return F.cross_entropy(input, label, reduction="none",
+                                   ignore_index=self.ignore_index)
+        # logits sharded on last dim: compute global max/sumexp via allreduce
+        from ....core.tensor import apply
+        per = input.shape[-1]
+        start = rank * per
+
+        local_max = Tensor(jnp.max(input._data, axis=-1))
+        all_reduce(local_max, ReduceOp.MAX, group=g)
+        gmax = local_max._data[..., None]
+        sumexp = Tensor(jnp.sum(jnp.exp(input._data.astype(jnp.float32) - gmax), -1))
+        all_reduce(sumexp, ReduceOp.SUM, group=g)
+        lab = label._data.astype(jnp.int32)
+        squeeze = lab.ndim == input._data.ndim and lab.shape[-1] == 1
+        if squeeze:
+            lab = lab[..., 0]
+        local = lab - start
+        in_range = (local >= 0) & (local < per)
+        safe = jnp.where(in_range, local, 0)
+        picked = jnp.take_along_axis(input._data.astype(jnp.float32),
+                                     safe[..., None], axis=-1)[..., 0]
+        picked = jnp.where(in_range, picked, 0.0)
+        picked_t = Tensor(picked)
+        all_reduce(picked_t, ReduceOp.SUM, group=g)
+        loss = jnp.log(sumexp._data) + gmax[..., 0] - picked_t._data
+        return Tensor(loss[..., None] if squeeze else loss)
+
+
+# mp_ops public names (reference mp_ops.py)
+mp_ops = type("mp_ops", (), {"_c_identity": staticmethod(_c_identity),
+                             "_c_concat": staticmethod(_c_concat),
+                             "_c_split": staticmethod(_c_split),
+                             "_mp_allreduce": staticmethod(_mp_allreduce)})

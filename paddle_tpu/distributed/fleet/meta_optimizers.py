@@ -1,0 +1,100 @@
+"""HybridParallelOptimizer (reference:
+`fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:251`) and the
+hybrid grad scaler."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...nn.clip import ClipGradByGlobalNorm
+from ..communication.ops import ReduceOp, all_reduce
+
+
+class _HybridGlobalNormClip(ClipGradByGlobalNorm):
+    """Global-norm clip whose squared-norm sum is reduced across mp/pp/sharding groups
+    (reference `_dygraph_clip` in hybrid_parallel_optimizer)."""
+
+    def __init__(self, inner: ClipGradByGlobalNorm, hcg):
+        super().__init__(inner.clip_norm)
+        self._hcg = hcg
+
+    def _reduce_global_norm_sq(self, global_norm):
+        sq = Tensor(jnp.square(global_norm))
+        if self._hcg.get_model_parallel_world_size() > 1:
+            all_reduce(sq, ReduceOp.SUM, group=self._hcg.get_model_parallel_group())
+        if self._hcg.get_pipe_parallel_world_size() > 1:
+            all_reduce(sq, ReduceOp.SUM, group=self._hcg.get_pipe_parallel_group())
+        if self._hcg.get_sharding_parallel_world_size() > 1:
+            all_reduce(sq, ReduceOp.SUM, group=self._hcg.get_sharding_parallel_group())
+        return jnp.sqrt(sq._data)
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        clip = optimizer._grad_clip
+        if isinstance(clip, ClipGradByGlobalNorm):
+            optimizer._grad_clip = _HybridGlobalNormClip(clip, hcg)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, *a, **kw):
+        self._inner_opt.clear_grad(*a, **kw)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, *a, **kw):
+        return self._inner_opt.minimize(loss, *a, **kw)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+    def set_lr(self, v):
+        return self._inner_opt.set_lr(v)
+
+    def get_lr(self):
+        return self._inner_opt.get_lr()
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+
+class HybridParallelGradScaler:
+    """(reference `hybrid_parallel_gradscaler.py`): found_inf is reduced across the
+    hybrid groups before the skip decision."""
+
+    def __init__(self, scaler, hcg):
+        self._scaler = scaler
+        self._hcg = hcg
+
+    def scale(self, var):
+        return self._scaler.scale(var)
+
+    def step(self, optimizer):
+        inner = optimizer._inner_opt if isinstance(optimizer, HybridParallelOptimizer) \
+            else optimizer
+        if self._scaler._enable:
+            self._scaler.unscale_(inner)
+            found = Tensor(jnp.asarray([1.0 if self._scaler._found_inf else 0.0]))
+            if self._hcg and self._hcg.get_model_parallel_world_size() > 1:
+                all_reduce(found, ReduceOp.MAX, group=self._hcg.get_model_parallel_group())
+            self._scaler._found_inf = bool(found._data[0] > 0)
+            self._scaler._unscaled = True
+        self._scaler.step(inner)
+
+    def update(self):
+        self._scaler.update()
+
+    def minimize(self, optimizer, loss):
+        loss.backward()
+        self.step(optimizer)
+        self.update()
+
+    def __getattr__(self, item):
+        return getattr(self._scaler, item)

@@ -1,0 +1,244 @@
+"""Meta-parallel wrappers (reference: `fleet/meta_parallel/` — `PipelineLayer`
+`parallel_layers/pp_layers.py:239`, `PipelineParallel` `pipeline_parallel.py`,
+`TensorParallel`).
+
+TPU-native pipeline: stages are segments of a LayerDesc list (reference SegmentLayers
+:92).  Eager multi-process 1F1B with NCCL p2p has no TPU analog — the compiled path
+(`paddle_tpu.parallel.pipeline`) runs the microbatch loop inside one jitted program
+with `shard_map`+ppermute over the 'pp' mesh axis.  This wrapper keeps the reference's
+train_batch API: single-process it runs the full model with microbatch gradient
+accumulation (exact 1F1B numerics); multi-process it instructs users to the compiled
+engine.
+"""
+from __future__ import annotations
+
+import re
+from typing import List
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...nn.layer.layers import Layer
+from ..parallel import sync_params_buffers
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *inputs, **kwargs):
+        self.layer_cls = layer_cls
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_cls(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_cls, forward_func=None, shared_weight_attr="weight",
+                 *inputs, **kwargs):
+        super().__init__(layer_cls, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Partition N layers into P stages (reference `SegmentLayers` :92)."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform"):
+        self.descs = layers_desc
+        self.num_parts = num_parts
+        self.method = method
+
+    def do_segment(self):
+        n = len(self.descs)
+        if self.method == "uniform":
+            base = n // self.num_parts
+            rem = n % self.num_parts
+            parts = [0]
+            for i in range(self.num_parts):
+                parts.append(parts[-1] + base + (1 if i < rem else 0))
+            return parts
+        if self.method.startswith("layer:"):
+            pat = self.method.split(":", 1)[1]
+            matches = [i for i, d in enumerate(self.descs)
+                       if re.search(pat, getattr(d.layer_cls, "__name__", str(d)))]
+            per = len(matches) // self.num_parts
+            parts = [0]
+            for i in range(1, self.num_parts):
+                parts.append(matches[i * per])
+            parts.append(n)
+            return parts
+        raise ValueError(f"unknown segment method {self.method}")
+
+
+class PipelineLayer(Layer):
+    """(reference `pp_layers.py:239`)."""
+
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0, num_virtual_pipeline_stages=None,
+                 **kwargs):
+        super().__init__()
+        from .topology import _get_hybrid_group
+        self._loss_fn = loss_fn
+        self.descs = list(layers)
+        hcg = _get_hybrid_group()
+        self._topo = topology
+        if num_stages is None:
+            num_stages = hcg.get_pipe_parallel_world_size() if hcg else 1
+        self._num_stages = num_stages
+        self._stage_id = hcg.get_stage_id() if hcg else 0
+        self.segment_parts = SegmentLayers(self.descs, num_stages, seg_method).do_segment()
+        self._recompute_interval = recompute_interval
+        start = self.segment_parts[self._stage_id]
+        end = self.segment_parts[self._stage_id + 1]
+        self._start, self._end = start, end
+        self._shared = {}
+        from .container_utils import build_desc_layer
+        self.run_function = []
+        for i in range(start, end):
+            desc = self.descs[i]
+            if isinstance(desc, SharedLayerDesc):
+                if desc.layer_name not in self._shared:
+                    self._shared[desc.layer_name] = desc.build_layer()
+                lyr = self._shared[desc.layer_name]
+                fwd = desc.forward_func
+                self.add_sublayer(f"shared_{desc.layer_name}_{i}", lyr)
+                if fwd is not None:
+                    self.run_function.append(lambda x, l=lyr, f=fwd: f(l, x))
+                else:
+                    self.run_function.append(lyr)
+            elif isinstance(desc, LayerDesc):
+                lyr = desc.build_layer()
+                self.add_sublayer(str(i), lyr)
+                self.run_function.append(lyr)
+            elif isinstance(desc, Layer):
+                self.add_sublayer(str(i), desc)
+                self.run_function.append(desc)
+            elif callable(desc):
+                self.run_function.append(desc)
+            else:
+                raise TypeError(f"bad pipeline item {desc}")
+
+    def get_stage_from_index(self, layer_idx):
+        for s in range(self._num_stages):
+            if self.segment_parts[s] <= layer_idx < self.segment_parts[s + 1]:
+                return s
+        return self._num_stages - 1
+
+    def forward(self, x):
+        from .recompute import recompute
+        for i, fn in enumerate(self.run_function):
+            if self._recompute_interval > 0 and i % self._recompute_interval == 0 \
+                    and isinstance(x, Tensor):
+                x = recompute(fn, x)
+            else:
+                x = fn(x)
+        return x
+
+
+class PipelineParallel(Layer):
+    """(reference `pipeline_parallel.py:590` train_batch / :387 1F1B).
+
+    Single-process: microbatched gradient accumulation — numerically identical to 1F1B.
+    Multi-process eager: directed to the compiled pipeline engine.
+    """
+
+    def __init__(self, layers, hcg, strategy):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        pp_cfg = strategy.pipeline_configs if strategy else {}
+        self.accumulate_steps = pp_cfg.get("accumulate_steps", 1)
+        self.micro_batch_size = pp_cfg.get("micro_batch_size", 1)
+        if hcg.get_data_parallel_world_size() > 1:
+            sync_params_buffers(layers, hcg.get_data_parallel_group())
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        if self._hcg.get_pipe_parallel_world_size() > 1 and \
+                self._hcg.get_mesh().size > len(jax.local_devices()):
+            raise RuntimeError(
+                "multi-process eager pipeline: use paddle_tpu.parallel.pipeline "
+                "(compiled 1F1B over the pp mesh axis)")
+        x, y = data
+        total = x.shape[0]
+        micro = max(total // self.accumulate_steps, 1)
+        losses = []
+        for i in range(0, total, micro):
+            xb = x[i:i + micro]
+            yb = y[i:i + micro]
+            out = self._layers(xb)
+            loss = self._layers._loss_fn(out, yb) if hasattr(self._layers, "_loss_fn") \
+                and self._layers._loss_fn else out
+            scaled = loss / self.accumulate_steps
+            if scaler is not None:
+                scaler.scale(scaled).backward()
+            else:
+                scaled.backward()
+            losses.append(loss)
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        from ...ops.manipulation import stack
+        from ...ops.math import mean
+        return mean(stack([l.detach() for l in losses]))
+
+    def eval_batch(self, data, compute_loss=True):
+        x, y = data
+        out = self._layers(x)
+        if compute_loss and getattr(self._layers, "_loss_fn", None):
+            return self._layers._loss_fn(out, y)
+        return out
+
+    def parameters(self, *a, **kw):
+        return self._layers.parameters(*a, **kw)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, sd, *a, **kw):
+        return self._layers.set_state_dict(sd, *a, **kw)
+
+
+import jax  # noqa: E402
+
+
+class TensorParallel(Layer):
+    """(reference `meta_parallel/tensor_parallel.py`): broadcast non-distributed params
+    within mp group, DP-sync across dp group."""
+
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        if hcg.get_model_parallel_world_size() > 1:
+            for p in layers.parameters():
+                if not getattr(p, "is_distributed", False):
+                    from ..communication.ops import broadcast
+                    broadcast(p, hcg.get_model_parallel_group_src_rank(),
+                              group=hcg.get_model_parallel_group())
+        if hcg.get_data_parallel_world_size() > 1:
+            sync_params_buffers(layers, hcg.get_data_parallel_group())
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def parameters(self, *a, **kw):
+        return self._layers.parameters(*a, **kw)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, sd, *a, **kw):
+        return self._layers.set_state_dict(sd, *a, **kw)

@@ -1,0 +1,106 @@
+"""Recompute / activation checkpointing (reference: `fleet/recompute/recompute.py` —
+PyLayer with RNG state replay).
+
+TPU-native: inside jit/`to_static`, `jax.checkpoint` is the engine (XLA remat).  In
+eager, a PyLayer-style whole-segment GradNode recomputes the forward under the saved
+RNG state at backward time — same semantics, tape-level.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import autograd as _ag
+from ...core import generator as _gen
+from ...core.tensor import Tensor
+
+
+def recompute(function, *args, **kwargs):
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    need_grad = _ag.is_grad_enabled() and any(not t.stop_gradient for t in tensor_args)
+
+    rng_key = _gen.default_generator().get_state() if preserve_rng_state else None
+
+    with _ag.set_grad_enabled(False):
+        if preserve_rng_state:
+            saved = _gen.default_generator().get_state()
+            _gen.default_generator().set_state(rng_key)
+        out = function(*args, **kwargs)
+        if preserve_rng_state:
+            _gen.default_generator().set_state(saved)
+    if not need_grad:
+        return out
+
+    single = not isinstance(out, (tuple, list))
+    out_list = [out] if single else list(out)
+
+    def vjp_fn(cots):
+        if not isinstance(cots, tuple):
+            cots = (cots,)
+        # rerun forward under grad with the saved RNG state, then pull back
+        if preserve_rng_state:
+            saved2 = _gen.default_generator().get_state()
+            _gen.default_generator().set_state(rng_key)
+        datas = [t._data for t in tensor_args]
+
+        def pure(*ds):
+            new_args = []
+            it = iter(ds)
+            for a in args:
+                if isinstance(a, Tensor):
+                    new_args.append(Tensor(next(it), stop_gradient=a.stop_gradient))
+                else:
+                    new_args.append(a)
+            with _ag.set_grad_enabled(False):
+                if preserve_rng_state:
+                    _gen.default_generator().set_state(rng_key)
+                o = function(*new_args, **kwargs)
+            o_list = [o] if not isinstance(o, (tuple, list)) else list(o)
+            return tuple(t._data for t in o_list if isinstance(t, Tensor))
+
+        _, pull = jax.vjp(pure, *datas)
+        grads = pull(tuple(cots))
+        if preserve_rng_state:
+            _gen.default_generator().set_state(saved2)
+        res = []
+        gi = iter(grads)
+        for a in args:
+            res.append(next(gi) if isinstance(a, Tensor) else None)
+        return tuple(res)
+
+    specs = [(tuple(t._data.shape), t._data.dtype) for t in out_list
+             if isinstance(t, Tensor)]
+    node = _ag.GradNode("recompute", vjp_fn, list(args),
+                        len([t for t in out_list if isinstance(t, Tensor)]), specs)
+    idx = 0
+    for t in out_list:
+        if isinstance(t, Tensor) and jnp.issubdtype(t._data.dtype, jnp.inexact):
+            t.stop_gradient = False
+            t._grad_node = node
+            t._out_index = idx
+        if isinstance(t, Tensor):
+            idx += 1
+    return out
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Segment a Sequential into recompute chunks (reference recompute_sequential)."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    seg_size = max(len(layers) // segments, 1)
+    out = args[0] if len(args) == 1 else args
+
+    def run_segment(start, end, x):
+        def seg_fn(inp):
+            h = inp
+            for l in layers[start:end]:
+                h = l(h)
+            return h
+        return recompute(seg_fn, x)
+
+    for s in range(0, len(layers), seg_size):
+        out = run_segment(s, min(s + seg_size, len(layers)), out)
+    return out

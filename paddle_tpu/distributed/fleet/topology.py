@@ -1,0 +1,220 @@
+"""Hybrid-parallel topology (reference: `fleet/base/topology.py:58` —
+`CommunicateTopology`, :144 `HybridCommunicateGroup`).
+
+TPU-native: the topology IS a `jax.sharding.Mesh`.  Axes follow the reference order
+["data", "pipe", "sharding", "sep", "model"]; each axis also materializes as a Group for
+the eager API, and `get_mesh()` hands the jit path its mesh for GSPMD shardings.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+from functools import reduce
+
+import numpy as np
+
+from ..communication.group import Group, new_group
+from ..parallel_env import ParallelEnv
+
+_HYBRID_PARALLEL_GROUP = None
+
+
+def _get_hybrid_group():
+    return _HYBRID_PARALLEL_GROUP
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "sep", "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = collections.namedtuple("Coordinate", self._parallel_names)
+        self.world_size = int(np.prod(self._dims))
+        ranges = [range(d) for d in self._dims]
+        all_coords = [self.coordinate(*c) for c in itertools.product(*ranges)]
+        self._coord2rank = dict(zip(all_coords, range(len(all_coords))))
+        self._rank2coord = dict(zip(self._coord2rank.values(), self._coord2rank.keys()))
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def get_rank(self, **args):
+        return self._coord2rank[self.coordinate(**args)]
+
+    def get_coord(self, rank):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        ranks = [self._coord2rank[c] for c in self._coord2rank
+                 if c[axis] == index]
+        return sorted(ranks)
+
+    def get_comm_list(self, axis_name):
+        """All rank-lists that differ only along axis_name."""
+        axis = self._parallel_names.index(axis_name)
+        other = [n for n in self._parallel_names if n != axis_name]
+        ranges = [range(self.get_dim(n)) for n in other]
+        comm_list = []
+        for combo in itertools.product(*ranges):
+            fixed = dict(zip(other, combo))
+            ranks = [self.get_rank(**{**fixed, axis_name: i})
+                     for i in range(self._dims[axis])]
+            comm_list.append(ranks)
+        return comm_list
+
+    def get_rank_from_stage(self, global_rank, **kwargs):
+        coord = self.get_coord(global_rank)
+        tf = coord._replace(**kwargs)._asdict()
+        return self.get_rank(**tf)
+
+
+class HybridCommunicateGroup:
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        env = ParallelEnv()
+        self.global_rank = env.rank
+        self._dp_degree = self._topo.get_dim("data")
+        self._mp_degree = self._topo.get_dim("model")
+        self._pp_degree = self._topo.get_dim("pipe")
+        self._sharding_degree = self._topo.get_dim("sharding")
+        self._sep_degree = self._topo.get_dim("sep") \
+            if "sep" in self._topo.get_hybrid_group_names() else 1
+
+        self._dp_group, self._dp_comm_group = self._set_comm_group("data")
+        self._mp_group, self._mp_comm_group = self._set_comm_group("model")
+        self._pp_group, self._pp_comm_group = self._set_comm_group("pipe")
+        self._sharding_group, self._sharding_comm_group = self._set_comm_group("sharding")
+        if self._sep_degree > 1:
+            self._sep_group, self._sep_comm_group = self._set_comm_group("sep")
+        else:
+            self._sep_group, self._sep_comm_group = None, None
+
+        coord = self._topo.get_coord(self.global_rank)
+        self.stage_id = coord.pipe
+        self._mesh = None
+
+    def _set_comm_group(self, axis_name):
+        comm_lists = self._topo.get_comm_list(axis_name)
+        my_group = None
+        my_ranks = None
+        for ranks in comm_lists:
+            group = new_group(ranks)
+            if self.global_rank in ranks:
+                my_group = group
+                my_ranks = ranks
+        return my_ranks, my_group
+
+    # ---- mesh (the TPU-native artifact) ----
+    def get_mesh(self):
+        """jax Mesh with axes (dp, pp, sharding[, sep], mp) over all devices.
+
+        Built lazily; in a single process over N local devices this is the N-device
+        mesh used by the jitted hybrid train step.
+        """
+        if self._mesh is None:
+            import jax
+            from jax.sharding import Mesh
+            names = []
+            sizes = []
+            for name, size in (("dp", self._dp_degree), ("pp", self._pp_degree),
+                               ("sharding", self._sharding_degree),
+                               ("sep", self._sep_degree), ("mp", self._mp_degree)):
+                names.append(name)
+                sizes.append(size)
+            n = int(np.prod(sizes))
+            devs = np.array(jax.devices()[:n]).reshape(sizes)
+            self._mesh = Mesh(devs, tuple(names))
+        return self._mesh
+
+    # ---- queries (reference API) ----
+    def get_parallel_mode(self):
+        if self._mp_degree == 1 and self._pp_degree == 1 and \
+                self._sharding_degree == 1 and self._dp_degree > 1:
+            return ParallelMode.DATA_PARALLEL
+        if self._sharding_degree > 1 and self._mp_degree == 1 and self._pp_degree == 1:
+            return ParallelMode.SHARDING_PARALLEL
+        if self._mp_degree > 1 and self._pp_degree == 1:
+            return ParallelMode.TENSOR_PARALLEL
+        if self._pp_degree > 1:
+            return ParallelMode.PIPELINE_PARALLEL
+        return ParallelMode.DATA_PARALLEL
+
+    def get_data_parallel_rank(self):
+        return self._topo.get_coord(self.global_rank).data
+
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_data_parallel_group(self):
+        return self._dp_comm_group
+
+    def get_data_parallel_group_src_rank(self):
+        return self._dp_group[0]
+
+    def get_model_parallel_rank(self):
+        return self._topo.get_coord(self.global_rank).model
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_model_parallel_group(self):
+        return self._mp_comm_group
+
+    def get_model_parallel_group_src_rank(self):
+        return self._mp_group[0]
+
+    def get_stage_id(self):
+        return self.stage_id
+
+    def get_pipe_parallel_rank(self):
+        return self.stage_id
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_pipe_parallel_group(self):
+        return self._pp_comm_group
+
+    def get_sharding_parallel_rank(self):
+        return self._topo.get_coord(self.global_rank).sharding
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_comm_group
+
+    def get_sharding_parallel_group_src_rank(self):
+        return self._sharding_group[0]
+
+    def get_sep_parallel_rank(self):
+        c = self._topo.get_coord(self.global_rank)
+        return getattr(c, "sep", 0)
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def get_sep_parallel_group(self):
+        return self._sep_comm_group
+
+    def get_p2p_groups(self):
+        return None
+
+    def topology(self):
+        return self._topo
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return self._topo.get_rank_from_stage(self.global_rank, pipe=stage_id, **kwargs)
+
+
+class ParallelMode:
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3

@@ -1,0 +1,185 @@
+"""Megatron-style sequence parallelism (reference:
+`fleet/utils/sequence_parallel_utils.py` — ScatterOp :83, AllGatherOp :109,
+ReduceScatterOp :125, ColumnSequenceParallelLinear :228, RowSequenceParallelLinear
+:340, register_sequence_parallel_allreduce_hooks :190).
+
+TPU-native note: under jit/GSPMD, sequence parallelism is a sharding of the sequence
+axis (PartitionSpec('mp') on dim 0 outside TP regions) — XLA inserts these exact
+all-gather/reduce-scatter pairs.  These eager ops keep the reference's explicit form
+for the imperative path and stamp `sequence_parallel` marks used by the fused
+allreduce hooks.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....core import autograd as _ag
+from ....core.tensor import Tensor
+from ....nn import functional as F
+from ....nn.initializer import XavierNormal
+from ....nn.layer.layers import Layer
+from ...communication.ops import ReduceOp, all_gather, all_reduce
+from ..layers.mpu import _mp_info
+
+
+def _make_node(name, x, out_data, vjp_fn):
+    out = Tensor(out_data)
+    if not x.stop_gradient and _ag.is_grad_enabled():
+        node = _ag.GradNode(name, vjp_fn, [x], 1, [(tuple(out_data.shape),
+                                                    out_data.dtype)])
+        out.stop_gradient = False
+        out._grad_node = node
+    return out
+
+
+def scatter(x):
+    """Split sequence dim (0) to this mp rank; backward = all-gather (ScatterOp)."""
+    world, rank, g = _mp_info()
+    if world <= 1:
+        return x
+    piece = jnp.split(x._data, world, axis=0)[rank]
+
+    def vjp_fn(cot):
+        t = Tensor(cot, stop_gradient=True)
+        parts = []
+        all_gather(parts, t, group=g)
+        return (jnp.concatenate([p._data for p in parts], axis=0),)
+    return _make_node("sp_scatter", x, piece, vjp_fn)
+
+
+def all_gather_sp(x):
+    """Gather sequence dim; backward = take local slice (AllGatherOp)."""
+    world, rank, g = _mp_info()
+    if world <= 1:
+        return x
+    parts = []
+    all_gather(parts, x, group=g)
+    full = jnp.concatenate([p._data for p in parts], axis=0)
+
+    def vjp_fn(cot):
+        return (jnp.split(cot, world, axis=0)[rank],)
+    return _make_node("sp_allgather", x, full, vjp_fn)
+
+
+def reduce_scatter_sp(x):
+    """Sum over mp group then keep local sequence slice; backward = all-gather
+    (ReduceScatterOp)."""
+    world, rank, g = _mp_info()
+    if world <= 1:
+        return x
+    t = Tensor(x._data)
+    all_reduce(t, ReduceOp.SUM, group=g)
+    piece = jnp.split(t._data, world, axis=0)[rank]
+
+    def vjp_fn(cot):
+        tt = Tensor(cot, stop_gradient=True)
+        parts = []
+        all_gather(parts, tt, group=g)
+        return (jnp.concatenate([p._data for p in parts], axis=0),)
+    return _make_node("sp_reduce_scatter", x, piece, vjp_fn)
+
+
+class ScatterOp:
+    @staticmethod
+    def apply(x):
+        return scatter(x)
+
+
+class AllGatherOp:
+    @staticmethod
+    def apply(x):
+        return all_gather_sp(x)
+
+
+class ReduceScatterOp:
+    @staticmethod
+    def apply(x):
+        return reduce_scatter_sp(x)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    parameter.sequence_parallel = True
+
+
+def is_sequence_parallel_parameter(parameter):
+    return getattr(parameter, "sequence_parallel", False)
+
+
+def create_fused_allreduce_gradient_hook(parameter_list, accumulation_steps):
+    world, _, g = _mp_info()
+    step = {"n": 0}
+
+    def hook(grad):
+        step["n"] += 1
+        if step["n"] % max(accumulation_steps, 1) == 0 and world > 1:
+            all_reduce(grad, ReduceOp.SUM, group=g)
+        return grad
+    return hook
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """(reference :190): norm/bias params marked sequence_parallel get their grads
+    allreduced across the mp group (their math ran on a sequence shard)."""
+    params = [p for p in model.parameters() if is_sequence_parallel_parameter(p)]
+    world, _, g = _mp_info()
+    if world <= 1:
+        return
+    for p in params:
+        def hook(grad, _p=p):
+            all_reduce(grad, ReduceOp.SUM, group=g)
+            return grad
+        p.register_hook(hook)
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """(reference :228): all-gather sequence shards -> column-parallel matmul."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=None,
+                 gather_output=False, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        world, rank, _ = _mp_info()
+        self.world_size = world
+        assert out_features % world == 0
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features // world], attr=weight_attr,
+            default_initializer=XavierNormal())
+        self.weight.is_distributed = world > 1
+        self.weight._dist_axes = (None, "mp")
+        self.bias = self.create_parameter(shape=[out_features // world], attr=None,
+                                          is_bias=True) if has_bias else None
+
+    def forward(self, x):
+        x = all_gather_sp(x)
+        return F.linear(x, self.weight, self.bias)
+
+
+class RowSequenceParallelLinear(Layer):
+    """(reference :340): row-parallel matmul -> reduce-scatter over sequence dim."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=True, fuse_matmul_bias=False, mp_group=None,
+                 name=None):
+        super().__init__()
+        world, rank, _ = _mp_info()
+        self.world_size = world
+        assert in_features % world == 0
+        self.weight = self.create_parameter(
+            shape=[in_features // world, out_features], attr=weight_attr,
+            default_initializer=XavierNormal())
+        self.weight.is_distributed = world > 1
+        self.weight._dist_axes = ("mp", None)
+        self.bias = self.create_parameter(shape=[out_features], attr=None,
+                                          is_bias=True) if has_bias else None
+        if self.bias is not None:
+            mark_as_sequence_parallel_parameter(self.bias)
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, None)
+        out = reduce_scatter_sp(out)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+GatherOp = AllGatherOp  # reference alias

@@ -1,0 +1,92 @@
+"""DataParallel (reference: `python/paddle/distributed/parallel.py:191`).
+
+Reference design: EagerReducer buckets grads + overlapped NCCL allreduce
+(`reducer.cc:740`).  TPU-native: with a single process per host driving an XLA mesh,
+the preferred DP is sharded-jit (see fleet.distributed_model's jit path) where XLA
+fuses the gradient reduction into the backward.  This eager wrapper keeps reference
+semantics: param broadcast at construction, grad allreduce hooks on backward
+(bucketed), `no_sync`, find_unused_parameters accepted.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from . import parallel_env
+from .communication.ops import ReduceOp, all_reduce, broadcast
+
+
+def sync_params_buffers(model, comm_group=None, src_rank=0, is_model_parallel=False):
+    for p in model.parameters():
+        broadcast(p, src_rank, group=comm_group)
+    for b in model.buffers():
+        if b is not None:
+            broadcast(b, src_rank, group=comm_group)
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False, group=None):
+        super().__init__()
+        self._layers = layers
+        self.group = group
+        self.find_unused_parameters = find_unused_parameters
+        self.comm_buffer_size = comm_buffer_size
+        self._grads_synced = True
+        self._enable_sync = True
+        env = parallel_env.ParallelEnv()
+        self._world = env.world_size if group is None else group.nranks
+        if self._world > 1:
+            sync_params_buffers(layers, group)
+        self._register_hooks()
+
+    def _register_hooks(self):
+        if self._world <= 1:
+            return
+        world = self._world
+        group = self.group
+        dp = self
+
+        for p in self._layers.parameters():
+            if p.stop_gradient:
+                continue
+
+            def hook(grad, _p=p):
+                if not dp._enable_sync:
+                    return grad
+                all_reduce(grad, ReduceOp.SUM, group=group)
+                return Tensor(grad._data / world, stop_gradient=True)
+            p.register_hook(hook)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        self._enable_sync = False
+        try:
+            yield
+        finally:
+            self._enable_sync = True
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def scale_loss(self, loss):
+        return loss
+
+
+init_parallel_env = parallel_env.init_parallel_env
+ParallelEnv = parallel_env.ParallelEnv

@@ -1,0 +1,401 @@
+"""Probability distributions (reference: `python/paddle/distribution/` — 15+
+distributions + transforms + kl).  Built on jax.random + jax.scipy."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import generator as _gen
+from ..core.tensor import Tensor, apply, _to_data
+
+
+def _t(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x, jnp.float32)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return Tensor(jnp.exp(_t(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(np.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + tuple(self._batch_shape)
+        z = jax.random.normal(_gen.next_key(), shape)
+        return Tensor(self.loc + self.scale * z)
+
+    def log_prob(self, value):
+        v = _t(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var) - jnp.log(self.scale)
+                      - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+                      + jnp.zeros(self._batch_shape))
+
+    def cdf(self, value):
+        return Tensor(0.5 * (1 + jax.scipy.special.erf(
+            (_t(value) - self.loc) / (self.scale * math.sqrt(2)))))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(np.broadcast_shapes(self.low.shape, self.high.shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + tuple(self._batch_shape)
+        u = jax.random.uniform(_gen.next_key(), shape)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = _t(value)
+        inside = (v >= self.low) & (v < self.high)
+        return Tensor(jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _t(logits)
+        super().__init__(self.logits.shape[:-1])
+
+    def sample(self, shape=()):
+        out = jax.random.categorical(_gen.next_key(), self.logits,
+                                     shape=tuple(shape) + tuple(self._batch_shape))
+        return Tensor(out.astype(jnp.int64))
+
+    def log_prob(self, value):
+        lp = jax.nn.log_softmax(self.logits, axis=-1)
+        v = _t(value).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(lp, v[..., None], axis=-1)[..., 0])
+
+    def probs(self, value=None):
+        p = jax.nn.softmax(self.logits, axis=-1)
+        if value is None:
+            return Tensor(p)
+        v = _t(value).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(p, v[..., None], axis=-1)[..., 0])
+
+    def entropy(self):
+        lp = jax.nn.log_softmax(self.logits, axis=-1)
+        return Tensor(-jnp.sum(jnp.exp(lp) * lp, axis=-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_v = _t(probs)
+        super().__init__(self.probs_v.shape)
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_gen.next_key(),
+                               tuple(shape) + tuple(self._batch_shape))
+        return Tensor((u < self.probs_v).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _t(value)
+        p = jnp.clip(self.probs_v, 1e-7, 1 - 1e-7)
+        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs_v, 1e-7, 1 - 1e-7)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(np.broadcast_shapes(self.alpha.shape, self.beta.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + tuple(self._batch_shape)
+        return Tensor(jax.random.beta(_gen.next_key(), self.alpha, self.beta, shape))
+
+    def log_prob(self, value):
+        v = _t(value)
+        lbeta = (jax.scipy.special.gammaln(self.alpha)
+                 + jax.scipy.special.gammaln(self.beta)
+                 - jax.scipy.special.gammaln(self.alpha + self.beta))
+        return Tensor((self.alpha - 1) * jnp.log(v) + (self.beta - 1) * jnp.log1p(-v)
+                      - lbeta)
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate):
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
+        super().__init__(np.broadcast_shapes(self.concentration.shape, self.rate.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + tuple(self._batch_shape)
+        g = jax.random.gamma(_gen.next_key(), self.concentration, shape)
+        return Tensor(g / self.rate)
+
+    def log_prob(self, value):
+        v = _t(value)
+        a, b = self.concentration, self.rate
+        return Tensor(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                      - jax.scipy.special.gammaln(a))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = _t(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.dirichlet(_gen.next_key(), self.concentration,
+                                           tuple(shape) + tuple(self._batch_shape)))
+
+    def log_prob(self, value):
+        v = _t(value)
+        a = self.concentration
+        norm = jnp.sum(jax.scipy.special.gammaln(a), -1) \
+            - jax.scipy.special.gammaln(jnp.sum(a, -1))
+        return Tensor(jnp.sum((a - 1) * jnp.log(v), -1) - norm)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate):
+        self.rate = _t(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        u = jax.random.exponential(_gen.next_key(),
+                                   tuple(shape) + tuple(self._batch_shape))
+        return Tensor(u / self.rate)
+
+    def log_prob(self, value):
+        return Tensor(jnp.log(self.rate) - self.rate * _t(value))
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(self.rate))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(np.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        z = jax.random.laplace(_gen.next_key(),
+                               tuple(shape) + tuple(self._batch_shape))
+        return Tensor(self.loc + self.scale * z)
+
+    def log_prob(self, value):
+        return Tensor(-jnp.abs(_t(value) - self.loc) / self.scale
+                      - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return Tensor(1 + jnp.log(2 * self.scale))
+
+
+class Geometric(Distribution):
+    def __init__(self, probs):
+        self.probs_v = _t(probs)
+        super().__init__(self.probs_v.shape)
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_gen.next_key(),
+                               tuple(shape) + tuple(self._batch_shape))
+        return Tensor(jnp.floor(jnp.log1p(-u) / jnp.log1p(-self.probs_v)))
+
+    def log_prob(self, value):
+        v = _t(value)
+        return Tensor(v * jnp.log1p(-self.probs_v) + jnp.log(self.probs_v))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(np.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        g = jax.random.gumbel(_gen.next_key(),
+                              tuple(shape) + tuple(self._batch_shape))
+        return Tensor(self.loc + self.scale * g)
+
+    def log_prob(self, value):
+        z = (_t(value) - self.loc) / self.scale
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(np.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        z = jax.random.normal(_gen.next_key(),
+                              tuple(shape) + tuple(self._batch_shape))
+        return Tensor(jnp.exp(self.loc + self.scale * z))
+
+    def log_prob(self, value):
+        v = _t(value)
+        lv = jnp.log(v)
+        var = self.scale ** 2
+        return Tensor(-((lv - self.loc) ** 2) / (2 * var) - lv - jnp.log(self.scale)
+                      - 0.5 * math.log(2 * math.pi))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs_v = _t(probs)
+        super().__init__(self.probs_v.shape[:-1], self.probs_v.shape[-1:])
+
+    def sample(self, shape=()):
+        logits = jnp.log(jnp.maximum(self.probs_v, 1e-30))
+        draws = jax.random.categorical(
+            _gen.next_key(), logits,
+            shape=(self.total_count,) + tuple(shape) + tuple(self._batch_shape))
+        k = self.probs_v.shape[-1]
+        onehot = jax.nn.one_hot(draws, k)
+        return Tensor(jnp.sum(onehot, axis=0))
+
+    def log_prob(self, value):
+        v = _t(value)
+        logits = jnp.log(jnp.maximum(self.probs_v, 1e-30))
+        return Tensor(jax.scipy.special.gammaln(jnp.sum(v, -1) + 1)
+                      - jnp.sum(jax.scipy.special.gammaln(v + 1), -1)
+                      + jnp.sum(v * logits, -1))
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = transforms if isinstance(transforms, (list, tuple)) else [transforms]
+        super().__init__(base._batch_shape, base._event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        lp = jnp.zeros(())
+        y = value
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            lp = lp - _t(t.forward_log_det_jacobian(x))
+            y = x
+        return Tensor(_t(self.base.log_prob(y)) + lp)
+
+
+class Transform:
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def forward(self, x):
+        return Tensor(self.loc + self.scale * _t(x))
+
+    def inverse(self, y):
+        return Tensor((_t(y) - self.loc) / self.scale)
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), _t(x).shape))
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return Tensor(jnp.exp(_t(x)))
+
+    def inverse(self, y):
+        return Tensor(jnp.log(_t(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(_t(x))
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return Tensor(jax.nn.sigmoid(_t(x)))
+
+    def inverse(self, y):
+        v = _t(y)
+        return Tensor(jnp.log(v) - jnp.log1p(-v))
+
+    def forward_log_det_jacobian(self, x):
+        v = _t(x)
+        return Tensor(-jax.nn.softplus(-v) - jax.nn.softplus(v))
+
+
+def kl_divergence(p, q):
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        var_ratio = (p.scale / q.scale) ** 2
+        t1 = ((p.loc - q.loc) / q.scale) ** 2
+        return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        lp = jax.nn.log_softmax(p.logits, -1)
+        lq = jax.nn.log_softmax(q.logits, -1)
+        return Tensor(jnp.sum(jnp.exp(lp) * (lp - lq), -1))
+    if isinstance(p, Uniform) and isinstance(q, Uniform):
+        return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+    if isinstance(p, Bernoulli) and isinstance(q, Bernoulli):
+        a = jnp.clip(p.probs_v, 1e-7, 1 - 1e-7)
+        b = jnp.clip(q.probs_v, 1e-7, 1 - 1e-7)
+        return Tensor(a * (jnp.log(a) - jnp.log(b))
+                      + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b)))
+    if isinstance(p, Exponential) and isinstance(q, Exponential):
+        r = p.rate / q.rate
+        return Tensor(jnp.log(r) + q.rate / p.rate - 1)
+    # fallback: monte-carlo estimate
+    x = p.sample((256,))
+    return Tensor(jnp.mean(_t(p.log_prob(x)) - _t(q.log_prob(x)), axis=0))

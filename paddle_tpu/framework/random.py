@@ -1,0 +1,4 @@
+from ..core import generator as _gen
+from ..ops.random import get_rng_state, set_rng_state  # noqa
+
+seed = _gen.seed

@@ -1,0 +1,2 @@
+"""Pallas TPU kernels for the fused-op inventory (reference:
+`paddle/phi/kernels/fusion/gpu/` CUDA kernels -> Mosaic/Pallas here)."""

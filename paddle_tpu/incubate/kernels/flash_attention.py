@@ -1,0 +1,197 @@
+"""Flash attention for TPU.
+
+Reference parity: `phi/kernels/gpu/flash_attn_kernel.cu` (wraps the flashattn CUDA lib).
+TPU-native: a Pallas kernel with online-softmax tiling — K blocks form the innermost
+("arbitrary") grid dimension with VMEM scratch carrying (acc, m, l) across iterations,
+so there are no in-kernel dynamic slices (Mosaic-friendly for head_dim 64/128/256).
+Forward runs the Pallas kernel on TPU; backward uses a rematerializing XLA pullback
+(custom_vjp) that XLA fuses into two matmul chains — the standard TPU trade (recompute
+beats spilling the S×S matrix to HBM).
+
+Fallbacks: CPU/debug or masked/dropout paths use the XLA composed implementation; the
+Pallas path covers the causal/no-mask hot case used by GPT pretraining.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() in ("tpu",) or \
+            jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# XLA reference implementation (also the VJP recompute path)
+# ---------------------------------------------------------------------------
+
+def attention_xla(q, k, v, mask=None, causal=False, scale=None, dropout_p=0.0,
+                  dropout_key=None):
+    """q,k,v: [B, S, H, D] (paddle layout)."""
+    D = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(D)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * s
+    if causal:
+        Lq, Lk = q.shape[1], k.shape[1]
+        row = jax.lax.broadcasted_iota(jnp.int32, (Lq, Lk), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (Lq, Lk), 1)
+        cmask = row + (Lk - Lq) >= col
+        logits = jnp.where(cmask[None, None], logits, NEG_INF)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, NEG_INF)
+        else:
+            logits = logits + mask.astype(jnp.float32)
+    p = jax.nn.softmax(logits, axis=-1)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel: grid (BH, n_q, n_k), K innermost with scratch carry
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                      block_q: int, block_k: int, n_k: int, causal: bool,
+                      scale: float):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    # causal: whole block above the diagonal contributes nothing — skip compute
+    run = True
+    if causal:
+        run = q_start + block_q - 1 >= k_start
+
+    @pl.when(run if causal else (ki >= 0))
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale        # [bq, D]
+        k = k_ref[0].astype(jnp.float32)                # [bk, D]
+        v = v_ref[0].astype(jnp.float32)                # [bk, D]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
+        if causal:
+            row = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            col = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(row >= col, s, NEG_INF)
+        m_prev = m_ref[...]
+        l_prev = l_ref[...]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)      # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+        l_ref[...] = l_new
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd_impl(q, k, v, causal, scale):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, S, H, D = q.shape
+    Sk = k.shape[1]
+    qt = jnp.transpose(q, (0, 2, 1, 3)).reshape(B * H, S, D)
+    kt = jnp.transpose(k, (0, 2, 1, 3)).reshape(B * H, Sk, D)
+    vt = jnp.transpose(v, (0, 2, 1, 3)).reshape(B * H, Sk, D)
+
+    block_q = min(256, S)
+    block_k = min(256, Sk)
+    n_k = Sk // block_k
+    grid = (B * H, S // block_q, n_k)
+    kernel = functools.partial(_flash_fwd_kernel, block_q=block_q, block_k=block_k,
+                               n_k=n_k, causal=causal, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(qt, kt, vt)
+    return jnp.transpose(out.reshape(B, H, S, D), (0, 2, 1, 3))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_attention_core(q, k, v, causal, scale):
+    """[B, S, H, D] in/out; Pallas forward, recompute backward."""
+    return _flash_fwd_impl(q, k, v, causal, scale)
+
+
+def _flash_core_fwd(q, k, v, causal, scale):
+    out = _flash_fwd_impl(q, k, v, causal, scale)
+    return out, (q, k, v)
+
+
+def _flash_core_bwd(causal, scale, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q_, k_, v_: attention_xla(q_, k_, v_, None, causal, scale),
+                     q, k, v)
+    return vjp(g)
+
+
+_flash_attention_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def _shapes_ok_for_pallas(q, k):
+    B, S, H, D = q.shape
+    Sk = k.shape[1]
+    if D not in (64, 128, 256):
+        return False
+    bq = min(256, S)
+    bk = min(256, Sk)
+    return S % bq == 0 and Sk % bk == 0 and S >= 128 and Sk >= 128
+
+
+def flash_attention_fused(q, k, v, mask=None, causal=False, scale=None,
+                          dropout_p=0.0):
+    """Entry used by incubate fused ops.  q,k,v: [B, S, H, D]."""
+    D = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(D)
+    if (mask is None and dropout_p == 0.0 and _on_tpu()
+            and _shapes_ok_for_pallas(q, k)):
+        return _flash_attention_core(q, k, v, causal, s)
+    key = None
+    if dropout_p > 0.0:
+        from ...core import generator as _gen
+        key = _gen.next_key()
+    return attention_xla(q, k, v, mask=mask, causal=causal, scale=s,
+                         dropout_p=dropout_p, dropout_key=key)

@@ -1,0 +1,34 @@
+"""Rotary position embedding (reference: `phi/kernels/fusion/gpu/fused_rope_kernel.cu`).
+
+Pure jnp: a rope application is elementwise muls/adds that XLA fuses into the
+surrounding matmul epilogue on TPU — a dedicated kernel buys nothing here.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def apply_rope(x, sin, cos, neox_style=True):
+    """x: [B, S, H, D]; sin/cos: [S, D/2] (or [B, S, D/2] after position-id gather)."""
+    D = x.shape[-1]
+    half = D // 2
+    if sin.ndim == 2:
+        sin_b = sin[None, :, None, :]
+        cos_b = cos[None, :, None, :]
+    else:
+        sin_b = sin[:, :, None, :]
+        cos_b = cos[:, :, None, :]
+    x32 = x.astype(jnp.float32)
+    if neox_style:
+        x1 = x32[..., :half]
+        x2 = x32[..., half:]
+        o1 = x1 * cos_b - x2 * sin_b
+        o2 = x2 * cos_b + x1 * sin_b
+        out = jnp.concatenate([o1, o2], axis=-1)
+    else:
+        x1 = x32[..., 0::2]
+        x2 = x32[..., 1::2]
+        o1 = x1 * cos_b - x2 * sin_b
+        o2 = x2 * cos_b + x1 * sin_b
+        out = jnp.stack([o1, o2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)

@@ -1,0 +1,2 @@
+from . import functional  # noqa
+from .layer import FusedLinear, FusedMultiHeadAttention, FusedTransformerEncoderLayer  # noqa

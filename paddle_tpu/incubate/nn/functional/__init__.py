@@ -1,0 +1,234 @@
+"""Fused ops (reference: `python/paddle/incubate/nn/functional/` — fused_matmul_bias,
+fused_rotary_position_embedding, fused_layer_norm, fused_rms_norm, fused_dropout_add,
+fused attention family; CUDA kernels in `phi/kernels/fusion/gpu/`).
+
+TPU-native: the hot kernels (flash attention, rms norm) have Pallas implementations in
+`paddle_tpu/incubate/kernels/`; the rest are written as single jnp expressions that XLA
+fuses into one kernel — on TPU that IS the fused implementation.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ....core import generator as _gen
+from ....core.tensor import Tensor, apply
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b, *rest):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = jnp.matmul(a, b)
+        if rest:
+            out = out + rest[0]
+        return out
+    args = (x, y) + ((bias,) if bias is not None else ())
+    return apply("fused_matmul_bias", f, *args)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    return fused_matmul_bias(x, weight, bias, False, transpose_weight)
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False, activation="gelu"):
+    out = fused_matmul_bias(x, y, bias, trans_x, trans_y)
+    from ....nn import functional as F
+    return getattr(F, activation)(out)
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6, begin_norm_axis=-1,
+                   bias=None, residual=None, quant_scale=-1, name=None):
+    """RMSNorm — routes to the Pallas kernel on TPU (reference
+    `fused_rms_norm_kernel.cu`)."""
+    from ...kernels.rms_norm import rms_norm_fused
+
+    def f(a, w, *rest):
+        it = iter(rest)
+        res = next(it) if residual is not None else None
+        b = next(it) if norm_bias is not None else None
+        if res is not None:
+            a = a + res
+        out = rms_norm_fused(a, w, epsilon)
+        if b is not None:
+            out = out + b
+        return (out, a) if res is not None else out
+    args = [x, norm_weight]
+    if residual is not None:
+        args.append(residual)
+    if norm_bias is not None:
+        args.append(norm_bias)
+    return apply("fused_rms_norm", f, *args)
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5, begin_norm_axis=-1,
+                     bias=None, residual=None, quant_scale=-1, name=None):
+    def f(a, w, b, *rest):
+        if rest:
+            a = a + rest[0]
+        mu = jnp.mean(a.astype(jnp.float32), axis=-1, keepdims=True)
+        var = jnp.var(a.astype(jnp.float32), axis=-1, keepdims=True)
+        out = ((a.astype(jnp.float32) - mu) * jax.lax.rsqrt(var + epsilon))
+        out = (out * w + b).astype(a.dtype)
+        return (out, a) if rest else out
+    args = [x, norm_weight, norm_bias]
+    if residual is not None:
+        args.append(residual)
+    return apply("fused_layer_norm", f, *args)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      fixed_seed_offset=None, rng_name="", name=None):
+    if not training or p == 0.0:
+        return apply("fused_dropout_add", jnp.add, x, y)
+
+    def f(a, b):
+        keep = jax.random.bernoulli(_gen.next_key(), 1.0 - p, a.shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype) + b
+        return jnp.where(keep, a, 0.0).astype(a.dtype) + b
+    return apply("fused_dropout_add", f, x, y)
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None, ln_scale=None,
+                                           ln_bias=None, dropout_rate=0.5,
+                                           ln_epsilon=1e-5, training=True, mode=
+                                           "upscale_in_train", name=None):
+    out = x if bias is None else x + bias
+    out = fused_dropout_add(out, residual, dropout_rate, training, mode)
+    from ....nn import functional as F
+    return F.layer_norm(out, out.shape[-1], ln_scale, ln_bias, ln_epsilon)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0,
+                                    name=None):
+    """RoPE (reference `fused_rope_kernel.cu`).  Layout [B, S, H, D]."""
+    from ...kernels.rope import apply_rope
+
+    outs = []
+    tensors = [t for t in (q, k, v) if t is not None]
+
+    def build(sin_d, cos_d, a):
+        return apply_rope(a, sin_d, cos_d, use_neox_rotary_style)
+
+    S = q.shape[1] if not time_major else q.shape[0]
+    D = q.shape[-1]
+    if sin is None:
+        inv = 1.0 / (rotary_emb_base ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
+        t_idx = jnp.arange(S, dtype=jnp.float32)
+        freqs = jnp.outer(t_idx, inv)
+        sin_d = jnp.sin(freqs)
+        cos_d = jnp.cos(freqs)
+    else:
+        sin_d = sin._data if isinstance(sin, Tensor) else jnp.asarray(sin)
+        cos_d = cos._data if isinstance(cos, Tensor) else jnp.asarray(cos)
+        # accept [1, S, 1, D] paddle layout; squeeze to [S, D/2]
+        sin_d = sin_d.reshape(S, -1)
+        cos_d = cos_d.reshape(S, -1)
+        if sin_d.shape[-1] == D:
+            sin_d = sin_d[:, : D // 2] if use_neox_rotary_style else sin_d[:, ::2]
+            cos_d = cos_d[:, : D // 2] if use_neox_rotary_style else cos_d[:, ::2]
+    if position_ids is not None:
+        pid = position_ids._data if isinstance(position_ids, Tensor) else jnp.asarray(position_ids)
+        sin_d = jnp.take(sin_d, pid.astype(jnp.int32), axis=0)
+        cos_d = jnp.take(cos_d, pid.astype(jnp.int32), axis=0)
+
+    for t in (q, k, v):
+        if t is None:
+            outs.append(None)
+            continue
+        outs.append(apply("fused_rope", lambda a: build(sin_d, cos_d, a), t))
+    return tuple(outs)
+
+
+def fused_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
+                                is_causal=False, training=True, scaling_factor=None,
+                                name=None):
+    """Fused SDPA [B, S, H, D] — Pallas flash attention on TPU, XLA path elsewhere
+    (reference `fused_dot_product_attention` / flash_attn)."""
+    from ...kernels.flash_attention import flash_attention_fused
+
+    def f(qq, kk, vv, *rest):
+        mask = rest[0] if rest else None
+        return flash_attention_fused(qq, kk, vv, mask=mask, causal=is_causal,
+                                     scale=scaling_factor,
+                                     dropout_p=dropout_p if training else 0.0)
+    args = (q, k, v) + ((attn_mask,) if attn_mask is not None else ())
+    return apply("fused_dot_product_attention", f, *args)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None, attn_mask=None,
+                               dropout_rate=0.5, attn_dropout_rate=0.5,
+                               ln_epsilon=1e-5, training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True, num_heads=None,
+                               transpose_qkv_wb=False, name=None):
+    from ....nn import functional as F
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, x.shape[-1], pre_ln_scale, pre_ln_bias, pre_ln_epsilon)
+    qkv = fused_matmul_bias(x, qkv_weight, qkv_bias)
+    B, S = x.shape[0], x.shape[1]
+    d_model = x.shape[-1]
+    if num_heads is None:
+        raise ValueError("num_heads required")
+    head_dim = d_model // num_heads
+    qkv = qkv.reshape([B, S, 3, num_heads, head_dim])
+    from ....ops.manipulation import split, squeeze
+    q, k, v = [squeeze(t, 2) for t in split(qkv, 3, axis=2)]
+    out = fused_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                      dropout_p=attn_dropout_rate if training else 0.0)
+    out = out.reshape([B, S, d_model])
+    out = fused_matmul_bias(out, linear_weight, linear_bias)
+    out = F.dropout(out, dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1], ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None, ln2_scale=None,
+                      ln2_bias=None, dropout1_rate=0.5, dropout2_rate=0.5,
+                      activation="relu", ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, mode="upscale_in_train",
+                      ring_id=-1, name=None):
+    from ....nn import functional as F
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, x.shape[-1], ln1_scale, ln1_bias, ln1_epsilon)
+    out = fused_matmul_bias(x, linear1_weight, linear1_bias)
+    out = getattr(F, activation)(out)
+    out = F.dropout(out, dropout1_rate, training=training, mode=mode)
+    out = fused_matmul_bias(out, linear2_weight, linear2_bias)
+    out = F.dropout(out, dropout2_rate, training=training, mode=mode)
+    out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1], ln2_scale, ln2_bias, ln2_epsilon)
+    return out
+
+
+def swiglu(x, y=None, name=None):
+    if y is not None:
+        return apply("swiglu", lambda a, b: jax.nn.silu(a) * b, x, y)
+
+    def f(a):
+        u, g = jnp.split(a, 2, axis=-1)
+        return jax.nn.silu(u) * g
+    return apply("swiglu", f, x)
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens=None,
+                                               kv_seq_lens=None, mask=None, scale=None,
+                                               causal=False, pre_cache_length=0):
+    return fused_dot_product_attention(query, key, value, attn_mask=mask,
+                                       is_causal=causal, scaling_factor=scale)

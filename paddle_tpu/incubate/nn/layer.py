@@ -1,0 +1,103 @@
+"""Fused layers (reference: `python/paddle/incubate/nn/layer/` — FusedLinear,
+FusedMultiHeadAttention, FusedTransformerEncoderLayer)."""
+from __future__ import annotations
+
+from ...nn.initializer import XavierNormal
+from ...nn.layer.layers import Layer
+from . import functional as IF
+
+
+class FusedLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None, bias_attr=None,
+                 transpose_weight=False, name=None):
+        super().__init__()
+        self._transpose = transpose_weight
+        shape = [out_features, in_features] if transpose_weight else [in_features, out_features]
+        self.weight = self.create_parameter(shape, weight_attr,
+                                            default_initializer=XavierNormal())
+        self.bias = self.create_parameter([out_features], bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return IF.fused_linear(x, self.weight, self.bias, self._transpose)
+
+
+class FusedMultiHeadAttention(Layer):
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5, attn_dropout_rate=0.5,
+                 kdim=None, vdim=None, normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None, linear_weight_attr=None,
+                 linear_bias_attr=None, pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5, nranks=1,
+                 ring_id=-1, name=None):
+        super().__init__()
+        from ...nn.initializer import Constant
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.normalize_before = normalize_before
+        self.qkv_weight = self.create_parameter([embed_dim, 3 * embed_dim],
+                                                qkv_weight_attr,
+                                                default_initializer=XavierNormal())
+        self.qkv_bias = self.create_parameter([3 * embed_dim], qkv_bias_attr,
+                                              is_bias=True)
+        self.linear_weight = self.create_parameter([embed_dim, embed_dim],
+                                                   linear_weight_attr,
+                                                   default_initializer=XavierNormal())
+        self.linear_bias = self.create_parameter([embed_dim], linear_bias_attr,
+                                                 is_bias=True)
+        self.pre_ln_scale = self.create_parameter([embed_dim], pre_ln_scale_attr,
+                                                  default_initializer=Constant(1.0))
+        self.pre_ln_bias = self.create_parameter([embed_dim], pre_ln_bias_attr,
+                                                 is_bias=True)
+        self.ln_scale = self.create_parameter([embed_dim], ln_scale_attr,
+                                              default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter([embed_dim], ln_bias_attr, is_bias=True)
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        return IF.fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.normalize_before, pre_ln_scale=self.pre_ln_scale,
+            pre_ln_bias=self.pre_ln_bias, ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            qkv_bias=self.qkv_bias, linear_bias=self.linear_bias, attn_mask=attn_mask,
+            dropout_rate=self.dropout_rate, attn_dropout_rate=self.attn_dropout_rate,
+            training=self.training, num_heads=self.num_heads)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None, act_dropout_rate=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None):
+        super().__init__()
+        attn_dropout_rate = dropout_rate if attn_dropout_rate is None else attn_dropout_rate
+        act_dropout_rate = dropout_rate if act_dropout_rate is None else act_dropout_rate
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate, normalize_before=normalize_before)
+        from ...nn.initializer import Constant
+        self.activation = activation
+        self.normalize_before = normalize_before
+        self.dropout1 = dropout_rate
+        self.act_dropout = act_dropout_rate
+        self.linear1_weight = self.create_parameter([d_model, dim_feedforward],
+                                                    weight_attr,
+                                                    default_initializer=XavierNormal())
+        self.linear1_bias = self.create_parameter([dim_feedforward], bias_attr,
+                                                  is_bias=True)
+        self.linear2_weight = self.create_parameter([dim_feedforward, d_model],
+                                                    weight_attr,
+                                                    default_initializer=XavierNormal())
+        self.linear2_bias = self.create_parameter([d_model], bias_attr, is_bias=True)
+        self.ln1_scale = self.create_parameter([d_model], None,
+                                               default_initializer=Constant(1.0))
+        self.ln1_bias = self.create_parameter([d_model], None, is_bias=True)
+        self.ln2_scale = self.create_parameter([d_model], None,
+                                               default_initializer=Constant(1.0))
+        self.ln2_bias = self.create_parameter([d_model], None, is_bias=True)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask)
+        return IF.fused_feedforward(
+            out, self.linear1_weight, self.linear2_weight, self.linear1_bias,
+            self.linear2_bias, self.ln1_scale, self.ln1_bias, self.ln2_scale,
+            self.ln2_bias, self.dropout1, self.act_dropout, self.activation,
+            pre_layer_norm=self.normalize_before, training=self.training)

@@ -1,0 +1,161 @@
+"""DataLoader (reference: `python/paddle/io/reader.py:216`).
+
+Multiprocess workers + prefetch: worker processes produce numpy batches over a
+`multiprocessing` queue (the reference's shared-mem mmap allocator path); the main
+process converts to device Tensors.  num_workers=0 runs synchronously in-process, like
+the reference.  A background prefetch thread keeps `prefetch_factor` batches in flight
+so host→HBM transfer overlaps step compute (AsyncLoader parity).
+"""
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+_worker_info = None
+
+
+def get_worker_info():
+    return _worker_info
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset, seed=0):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(s._data) for s in batch])
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    if isinstance(sample, (list, tuple)):
+        return [default_collate_fn([b[i] for b in batch]) for i in range(len(sample))]
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    return np.asarray(batch)
+
+
+def _to_tensors(batch, places=None):
+    if isinstance(batch, np.ndarray):
+        return Tensor(batch)
+    if isinstance(batch, (list, tuple)):
+        return [_to_tensors(b, places) for b in batch]
+    if isinstance(batch, dict):
+        return {k: _to_tensors(v, places) for k, v in batch.items()}
+    if isinstance(batch, Tensor):
+        return batch
+    return Tensor(np.asarray(batch))
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True,
+                 batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
+                 collate_fn=None, num_workers=0, use_buffer_reader=True,
+                 prefetch_factor=2, use_shared_memory=True, timeout=0,
+                 worker_init_fn=None, persistent_workers=False):
+        self.dataset = dataset
+        self.places = places
+        self.return_list = return_list
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = max(0, int(num_workers))
+        self.prefetch_factor = prefetch_factor
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self._iterable_ds = isinstance(dataset, IterableDataset)
+        if self._iterable_ds:
+            self.batch_size = batch_size
+            self.batch_sampler = None
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = getattr(batch_sampler, "batch_size", batch_size)
+        else:
+            if batch_size is None:
+                self.batch_sampler = None
+                self.batch_size = None
+            else:
+                self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                                  batch_size=batch_size,
+                                                  drop_last=drop_last)
+                self.batch_size = batch_size
+
+    def __len__(self):
+        if self._iterable_ds:
+            raise TypeError("IterableDataset has no len()")
+        if self.batch_sampler is None:
+            return len(self.dataset)
+        return len(self.batch_sampler)
+
+    # ---- single-process iteration ----
+    def _iter_sync(self):
+        if self._iterable_ds:
+            global _worker_info
+            _worker_info = WorkerInfo(0, 1, self.dataset)
+            if self.worker_init_fn:
+                self.worker_init_fn(0)
+            it = iter(self.dataset)
+            while True:
+                batch = list(itertools.islice(it, self.batch_size))
+                if not batch:
+                    return
+                if len(batch) < self.batch_size and self.drop_last:
+                    return
+                yield _to_tensors(self.collate_fn(batch), self.places)
+        elif self.batch_sampler is None:
+            for i in range(len(self.dataset)):
+                yield _to_tensors(self.dataset[i], self.places)
+        else:
+            for indices in self.batch_sampler:
+                batch = [self.dataset[i] for i in indices]
+                yield _to_tensors(self.collate_fn(batch), self.places)
+
+    # ---- threaded prefetch (overlap host work with device compute) ----
+    def _iter_prefetch(self):
+        q: _queue.Queue = _queue.Queue(maxsize=self.prefetch_factor * max(self.num_workers, 1))
+        sentinel = object()
+        err = []
+
+        def producer():
+            try:
+                if self._iterable_ds:
+                    for item in self._iter_sync():
+                        q.put(item)
+                else:
+                    for indices in self.batch_sampler:
+                        batch = [self.dataset[i] for i in indices]
+                        q.put(_to_tensors(self.collate_fn(batch), self.places))
+            except BaseException as e:  # surface worker errors in main thread
+                err.append(e)
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            yield item
+        if err:
+            raise err[0]
+
+    def __iter__(self):
+        if self.num_workers == 0:
+            return self._iter_sync()
+        return self._iter_prefetch()

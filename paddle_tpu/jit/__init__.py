@@ -1,0 +1,2 @@
+from .api import TranslatedLayer, load, not_to_static, save, to_static  # noqa
+from .program import StaticFunction, functionalize  # noqa

@@ -1,0 +1,131 @@
+"""jit.to_static / jit.save / jit.load (reference: `python/paddle/jit/api.py` :233/:816).
+
+Serialization uses `jax.export` (StableHLO) — the compiled program is portable across
+processes without the original Python code, matching the reference's
+Program+params `jit.save` contract (`translated_layer.py`).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..static.input_spec import InputSpec
+from .program import StaticFunction, functionalize
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
+              **kwargs):
+    """Decorator/wrapper converting a dygraph function or Layer to a compiled program."""
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            static = StaticFunction(fn, input_spec)
+            fn.forward = static
+            fn._static_function = static
+            return fn
+        return StaticFunction(fn, input_spec)
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def _resolve_specs(layer, input_spec):
+    if input_spec is None:
+        raise ValueError("jit.save needs input_spec (or call the layer once and pass "
+                         "the example inputs as input_spec)")
+    specs = []
+    for s in input_spec:
+        if isinstance(s, InputSpec):
+            specs.append(jax.ShapeDtypeStruct(
+                tuple(1 if (d is None or d == -1) else int(d) for d in s.shape),
+                np.dtype(s.dtype.np_dtype if hasattr(s.dtype, "np_dtype") else s.dtype)))
+        elif isinstance(s, Tensor):
+            specs.append(jax.ShapeDtypeStruct(tuple(s._data.shape), s._data.dtype))
+        else:
+            arr = np.asarray(s)
+            specs.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
+    return specs
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Serialize layer program (StableHLO via jax.export) + params."""
+    from jax import export as jax_export
+
+    was_training = layer.training if isinstance(layer, Layer) else False
+    if isinstance(layer, Layer):
+        layer.eval()
+    try:
+        fn = layer.forward if isinstance(layer, Layer) else layer
+        if isinstance(fn, StaticFunction):
+            fn = fn._fn
+        pure_fn, params, buffers = functionalize(fn, layer if isinstance(layer, Layer) else None)
+        specs = _resolve_specs(layer, input_spec)
+        p_datas = [p._data for _, p in params]
+        b_datas = [b._data for _, b in buffers]
+        from .program import _flatten_inputs
+        dummy_tensors = tuple(Tensor(jnp.zeros(s.shape, s.dtype)) for s in specs)
+        _, in_tree = _flatten_inputs(dummy_tensors, {})
+        pure_fn._in_tree = in_tree
+
+        def infer_fn(*in_datas):
+            flat = pure_fn(p_datas, b_datas, *in_datas)
+            return flat[:len(flat) - len(buffers)]
+
+        exported = jax_export.export(jax.jit(infer_fn))(*specs)
+        blob = exported.serialize()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path + ".pdmodel", "wb") as f:
+            f.write(blob)
+        state = {name: np.asarray(p._data) for name, p in params}
+        state.update({name: np.asarray(b._data) for name, b in buffers})
+        meta = {"out_tree": getattr(pure_fn, "_out_tree", None),
+                "n_outputs": None}
+        with open(path + ".pdiparams", "wb") as f:
+            pickle.dump({"state": state, "meta": meta}, f)
+    finally:
+        if isinstance(layer, Layer) and was_training:
+            layer.train()
+
+
+class TranslatedLayer(Layer):
+    """Loaded program wrapper (reference `translated_layer.py` TranslatedLayer)."""
+
+    def __init__(self, exported, meta):
+        super().__init__()
+        self._exported = exported
+        self._meta = meta
+
+    def forward(self, *args):
+        datas = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+        outs = self._exported.call(*datas)
+        tree = self._meta.get("out_tree")
+        tensors = [Tensor(o) for o in (outs if isinstance(outs, (tuple, list)) else [outs])]
+        if tree is not None:
+            from .program import _unflatten_outputs
+            try:
+                return _unflatten_outputs(tensors, tree)
+            except Exception:
+                pass
+        return tensors[0] if len(tensors) == 1 else tuple(tensors)
+
+
+def load(path, **configs):
+    from jax import export as jax_export
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    meta = {}
+    if os.path.exists(path + ".pdiparams"):
+        with open(path + ".pdiparams", "rb") as f:
+            meta = pickle.load(f).get("meta", {})
+    return TranslatedLayer(exported, meta)

@@ -1,0 +1,267 @@
+"""Static capture: dygraph -> compiled XLA program.
+
+Reference parity: dy2static (`python/paddle/jit/dy2static/program_translator.py` —
+`StaticFunction` :311, `CacheKey` :184, `ConcreteProgram` :1129) and its executor
+(`PartialProgramLayer` -> `run_program` op).
+
+TPU-native design: *tracing*, not AST rewriting — the idiomatic JAX capture. A Layer's
+forward is functionalized over (params, buffers, inputs); the jaxpr IS the Program IR
+(the reference's ProgramDesc / new-IR layer both collapse into it).  Forward runs as one
+jitted XLA executable; for training the whole program becomes a single GradNode on the
+eager tape whose pullback is a separately-jitted rematerializing VJP — `.backward()`
+then costs one compiled backward pass, exactly the run_program_op grad-node pattern.
+Buffer mutations (BN running stats, RNG-free side state) are captured as extra outputs
+and written back, keeping eager semantics.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd as _ag
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+
+class CacheKey:
+    """Program-cache key from input specs + train flag (reference `CacheKey` :184)."""
+
+    @staticmethod
+    def make(args, kwargs, training, with_grad):
+        def spec(x):
+            if isinstance(x, Tensor):
+                return ("T", tuple(x._data.shape), str(x._data.dtype),
+                        bool(x.stop_gradient))
+            if isinstance(x, (np.ndarray, jnp.ndarray)):
+                return ("A", tuple(np.shape(x)), str(np.asarray(x).dtype))
+            if isinstance(x, (list, tuple)):
+                return tuple(spec(v) for v in x)
+            if isinstance(x, dict):
+                return tuple(sorted((k, spec(v)) for k, v in x.items()))
+            return ("P", x)
+        return (spec(args), spec(kwargs), training, with_grad)
+
+
+def functionalize(fn: Callable, layer: Optional[Layer]):
+    """Build (pure_fn, params, buffers): pure_fn(param_datas, buffer_datas, *in_datas)
+    -> (flat outputs, out_treedef, new_buffer_datas), executed with the eager tape off
+    so ops trace straight into jnp."""
+    params: List[Tuple[str, Tensor]] = []
+    buffers: List[Tuple[str, Tensor]] = []
+    if layer is not None:
+        params = list(layer.named_parameters())
+        buffers = list(layer.named_buffers())
+
+    def pure_fn(param_datas, buffer_datas, *in_datas):
+        saved_p = [p._data for _, p in params]
+        saved_b = [b._data for _, b in buffers]
+        try:
+            for (_, p), d in zip(params, param_datas):
+                p._data = d
+            for (_, b), d in zip(buffers, buffer_datas):
+                b._data = d
+            args, kwargs = _unflatten_inputs(in_datas, pure_fn._in_tree)
+            with _ag.set_grad_enabled(False):
+                out = fn(*args, **kwargs)
+            flat_out, tree = _flatten_outputs(out)
+            new_buf = [b._data for _, b in buffers]
+            pure_fn._out_tree = tree
+            return tuple(flat_out) + tuple(new_buf)
+        finally:
+            for (_, p), d in zip(params, saved_p):
+                p._data = d
+            for (_, b), d in zip(buffers, saved_b):
+                b._data = d
+
+    return pure_fn, params, buffers
+
+
+def _flatten_inputs(args, kwargs):
+    """Split (args, kwargs) into (leaf jnp datas, treedef with Tensor positions)."""
+    leaves = []
+
+    def rec(x):
+        if isinstance(x, Tensor):
+            leaves.append(x._data)
+            return ("__leaf__", len(leaves) - 1, x.stop_gradient)
+        if isinstance(x, (list, tuple)):
+            return type(x)(rec(v) for v in x)
+        if isinstance(x, dict):
+            return {k: rec(v) for k, v in x.items()}
+        return x
+    tree = (tuple(rec(a) for a in args), {k: rec(v) for k, v in kwargs.items()})
+    return leaves, tree
+
+
+def _unflatten_inputs(datas, tree):
+    def rec(x):
+        if isinstance(x, tuple) and len(x) == 3 and x[0] == "__leaf__":
+            t = Tensor(datas[x[1]], stop_gradient=x[2])
+            return t
+        if isinstance(x, tuple):
+            return tuple(rec(v) for v in x)
+        if isinstance(x, list):
+            return [rec(v) for v in x]
+        if isinstance(x, dict):
+            return {k: rec(v) for k, v in x.items()}
+        return x
+    args_tree, kw_tree = tree
+    return tuple(rec(a) for a in args_tree), {k: rec(v) for k, v in kw_tree.items()}
+
+
+def _flatten_outputs(out):
+    leaves = []
+
+    def rec(x):
+        if isinstance(x, Tensor):
+            leaves.append(x._data)
+            return ("__leaf__", len(leaves) - 1)
+        if isinstance(x, (jnp.ndarray, jax.Array)):
+            leaves.append(x)
+            return ("__leaf__", len(leaves) - 1)
+        if isinstance(x, (list, tuple)):
+            return type(x)(rec(v) for v in x)
+        if isinstance(x, dict):
+            return {k: rec(v) for k, v in x.items()}
+        return x
+    tree = rec(out)
+    return leaves, tree
+
+
+def _unflatten_outputs(leaf_tensors, tree):
+    def rec(x):
+        if isinstance(x, tuple) and len(x) == 2 and x[0] == "__leaf__":
+            return leaf_tensors[x[1]]
+        if isinstance(x, tuple):
+            return tuple(rec(v) for v in x)
+        if isinstance(x, list):
+            return [rec(v) for v in x]
+        if isinstance(x, dict):
+            return {k: rec(v) for k, v in x.items()}
+        return x
+    return rec(tree)
+
+
+class ConcreteProgram:
+    """One compiled specialization (reference `ConcreteProgram` :1129)."""
+
+    def __init__(self, pure_fn, params, buffers, in_tree, donate=False):
+        self.pure_fn = pure_fn
+        self.params = params
+        self.buffers = buffers
+        self.in_tree = in_tree
+        self.out_tree = None
+        self.n_outputs = None
+        self._fwd = jax.jit(pure_fn)
+        self._vjp = None  # built lazily for training
+
+    def run(self, in_datas, with_grad, input_tensors):
+        self.pure_fn._in_tree = self.in_tree
+        p_datas = [p._data for _, p in self.params]
+        b_datas = [b._data for _, b in self.buffers]
+
+        if not with_grad:
+            flat = self._fwd(p_datas, b_datas, *in_datas)
+            return self._postprocess(flat, node=None)
+
+        # Training: whole-program GradNode; pullback = jitted remat VJP.
+        if self._vjp is None:
+            def vjp_run(pd, bd, ins, cots):
+                def fwd_only(pd_, ins_):
+                    self.pure_fn._in_tree = self.in_tree
+                    return self.pure_fn(pd_, bd, *ins_)
+                _, pull = jax.vjp(fwd_only, pd, ins)
+                return pull(cots)
+            self._vjp = jax.jit(vjp_run)
+
+        flat = self._fwd(p_datas, b_datas, *in_datas)
+        n_out = len(flat) - len(self.buffers)
+        out_specs = [(tuple(o.shape), o.dtype) for o in flat]
+
+        prog = self
+        in_datas_saved = tuple(in_datas)
+        pd_saved = tuple(p_datas)
+        bd_saved = tuple(b_datas)
+
+        def vjp_fn(cots):
+            if not isinstance(cots, tuple):
+                cots = (cots,)
+            full_cots = list(cots)
+            # zero cotangents for buffer outputs
+            while len(full_cots) < len(flat):
+                i = len(full_cots)
+                full_cots.append(jnp.zeros(out_specs[i][0], out_specs[i][1]))
+            gp, gins = prog._vjp(pd_saved, bd_saved, in_datas_saved, tuple(full_cots))
+            return tuple(gp) + tuple(gins)
+
+        node_inputs = [p for _, p in self.params] + list(input_tensors)
+
+        def vjp_wrap(cots):
+            grads = vjp_fn(cots)
+            return grads
+        node = _ag.GradNode("run_program", vjp_wrap, node_inputs, len(flat), out_specs)
+        return self._postprocess(flat, node=node)
+
+    def _postprocess(self, flat, node):
+        n_buf = len(self.buffers)
+        n_out = len(flat) - n_buf
+        out_leaves = flat[:n_out]
+        new_buf = flat[n_out:]
+        for (_, b), d in zip(self.buffers, new_buf):
+            b._data = d
+        tensors = []
+        for i, o in enumerate(out_leaves):
+            t = Tensor(o)
+            if node is not None and jnp.issubdtype(o.dtype, jnp.inexact):
+                t.stop_gradient = False
+                t._grad_node = node
+                t._out_index = i
+            tensors.append(t)
+        tree = self.pure_fn._out_tree
+        return _unflatten_outputs(tensors, tree)
+
+
+class StaticFunction:
+    """`@to_static` callable with a program cache (reference `StaticFunction` :311)."""
+
+    def __init__(self, function, input_spec=None, build_strategy=None, backend=None,
+                 **kwargs):
+        if isinstance(function, Layer):
+            self._layer = function
+            self._fn = function.forward
+            self._bound_instance = function
+        else:
+            self._layer = getattr(function, "__self__", None)
+            self._fn = function
+            self._bound_instance = None
+        self._input_spec = input_spec
+        self._cache: Dict[Any, ConcreteProgram] = {}
+        functools.update_wrapper(self, self._fn)
+
+    @property
+    def program_cache(self):
+        return self._cache
+
+    def concrete_program_specify_input_spec(self, input_spec=None):
+        return None
+
+    def __call__(self, *args, **kwargs):
+        layer = self._layer if isinstance(self._layer, Layer) else None
+        training = layer.training if layer is not None else False
+        with_grad = _ag.is_grad_enabled() and (
+            (layer is not None and any(not p.stop_gradient for p in layer.parameters()))
+            or any(isinstance(a, Tensor) and not a.stop_gradient for a in args))
+        key = CacheKey.make(args, kwargs, training, with_grad)
+        in_datas, in_tree = _flatten_inputs(args, kwargs)
+        prog = self._cache.get(key)
+        if prog is None:
+            pure_fn, params, buffers = functionalize(self._fn, layer)
+            pure_fn._in_tree = in_tree
+            prog = ConcreteProgram(pure_fn, params, buffers, in_tree)
+            self._cache[key] = prog
+        input_tensors = [a for a in args if isinstance(a, Tensor)]
+        return prog.run(in_datas, with_grad, input_tensors)

@@ -1,0 +1,7 @@
+"""paddle.nn parity surface."""
+from . import functional  # noqa
+from . import initializer  # noqa
+from .layer import *  # noqa
+from .layer import Layer  # noqa
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa
+from .utils import clip_grad_norm_, clip_grad_value_, parameters_to_vector, vector_to_parameters  # noqa

@@ -1,0 +1,176 @@
+"""Activation functionals (reference: `python/paddle/nn/functional/activation.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import generator as _gen
+from ...core.tensor import Tensor, apply
+
+
+def relu(x, name=None):
+    return apply("relu", jax.nn.relu, x)
+
+
+def relu_(x, name=None):
+    return x._inplace_from(relu(x))
+
+
+def relu6(x, name=None):
+    return apply("relu6", jax.nn.relu6, x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply("elu", lambda a: jax.nn.elu(a, alpha), x)
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply("celu", lambda a: jax.nn.celu(a, alpha), x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply("selu", lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x)
+
+
+def gelu(x, approximate=False, name=None):
+    return apply("gelu", lambda a: jax.nn.gelu(a, approximate=approximate), x)
+
+
+def silu(x, name=None):
+    return apply("silu", jax.nn.silu, x)
+
+
+swish = silu
+
+
+def sigmoid(x, name=None):
+    return apply("sigmoid", jax.nn.sigmoid, x)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply("hardsigmoid", lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), x)
+
+
+def hardswish(x, name=None):
+    return apply("hardswish", lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply("hardtanh", lambda a: jnp.clip(a, min, max), x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply("hardshrink", lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply("softshrink",
+                 lambda a: jnp.where(a > threshold, a - threshold,
+                                     jnp.where(a < -threshold, a + threshold, 0.0)), x)
+
+
+def tanhshrink(x, name=None):
+    return apply("tanhshrink", lambda a: a - jnp.tanh(a), x)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply("thresholded_relu", lambda a: jnp.where(a > threshold, a, value), x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply("leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope), x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        shape = [1] * a.ndim
+        ch_axis = 1 if data_format[1] == "C" else a.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+    return apply("prelu", f, x, weight)
+
+
+def rrelu(x, lower=0.125, upper=0.3333333, training=False, name=None):
+    if training:
+        def f(a):
+            k = _gen.next_key()
+            slope = jax.random.uniform(k, a.shape, a.dtype, lower, upper)
+            return jnp.where(a >= 0, a, slope * a)
+        return apply("rrelu", f, x)
+    mid = (lower + upper) / 2.0
+    return apply("rrelu", lambda a: jnp.where(a >= 0, a, mid * a), x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        newshape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(newshape), axis=ax + 1)
+    return apply("maxout", f, x)
+
+
+def mish(x, name=None):
+    return apply("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)), x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply("softplus",
+                 lambda a: jnp.where(beta * a > threshold, a,
+                                     (1.0 / beta) * jnp.log1p(jnp.exp(beta * a))), x)
+
+
+def softsign(x, name=None):
+    return apply("softsign", jax.nn.soft_sign, x)
+
+
+def tanh(x, name=None):
+    return apply("tanh", jnp.tanh, x)
+
+
+def tanh_(x, name=None):
+    return x._inplace_from(tanh(x))
+
+
+def log_sigmoid(x, name=None):
+    return apply("log_sigmoid", jax.nn.log_sigmoid, x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def f(a):
+        if dtype is not None:
+            from ...core import dtype as _dt
+            a = a.astype(_dt.to_np(dtype))
+        return jax.nn.softmax(a, axis=axis)
+    return apply("softmax", f, x)
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return x._inplace_from(softmax(x, axis, dtype))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def f(a):
+        if dtype is not None:
+            from ...core import dtype as _dt
+            a = a.astype(_dt.to_np(dtype))
+        return jax.nn.log_softmax(a, axis=axis)
+    return apply("log_softmax", f, x)
+
+
+def glu(x, axis=-1, name=None):
+    return apply("glu", lambda a: jax.nn.glu(a, axis=axis), x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    def f(a):
+        g = jax.random.gumbel(_gen.next_key(), a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis)
+            onehot = jax.nn.one_hot(idx, y.shape[axis], axis=axis, dtype=y.dtype)
+            y = onehot + y - jax.lax.stop_gradient(y)
+        return y
+    return apply("gumbel_softmax", f, x)

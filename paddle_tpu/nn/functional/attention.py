@@ -1,0 +1,23 @@
+"""Attention functionals.
+
+`scaled_dot_product_attention` is the paddle-API entry; on TPU it routes to the Pallas
+flash-attention kernel (incubate) when shapes allow, else the XLA softmax path.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import apply
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """Inputs [B, L, H, D] (paddle layout).  Reference:
+    `python/paddle/nn/functional/flash_attention.py:200`."""
+    from ...incubate.nn.functional import fused_dot_product_attention
+    return fused_dot_product_attention(query, key, value, attn_mask=attn_mask,
+                                       dropout_p=dropout_p, is_causal=is_causal,
+                                       training=training)

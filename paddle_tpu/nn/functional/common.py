@@ -1,0 +1,222 @@
+"""Common functionals: linear, dropout, interpolate, one_hot, pad…
+(reference: `python/paddle/nn/functional/common.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import dtype as _dt
+from ...core import generator as _gen
+from ...core.tensor import Tensor, apply, _to_data
+from ...ops.manipulation import pad as _pad_op
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with W stored [in, out] (reference phi `matmul` + `elementwise_add`;
+    maps to one MXU matmul with fused bias add under XLA)."""
+    if bias is None:
+        return apply("linear", lambda a, w: jnp.matmul(a, w), x, weight)
+    return apply("linear", lambda a, w, b: jnp.matmul(a, w) + b, x, weight, bias)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply("dropout_scale", lambda a: a * (1.0 - p), x)
+        return x
+    if isinstance(p, Tensor):
+        p = float(p.item())
+
+    def f(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(_gen.next_key(), 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+    return apply("dropout", f, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+
+    def f(a):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(_gen.next_key(), 1.0 - p, a.shape)
+        a_scale = (1.0 / np.sqrt((1 - p) * (1 + p * alpha_p ** 2))).astype(np.float32)
+        b = -a_scale * alpha_p * p
+        return (jnp.where(keep, a, alpha_p) * a_scale + b).astype(a.dtype)
+    return apply("alpha_dropout", f, x)
+
+
+def one_hot(x, num_classes, name=None):
+    return apply("one_hot", lambda a: jax.nn.one_hot(a.astype(jnp.int32), num_classes,
+                                                     dtype=jnp.float32), x)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(lab, *rest):
+        k = lab.shape[-1]
+        if rest:
+            return (1 - epsilon) * lab + epsilon * rest[0]
+        return (1 - epsilon) * lab + epsilon / k
+    args = (label,) if prior_dist is None else (label, prior_dist)
+    return apply("label_smooth", f, *args)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return apply("cosine_similarity", f, x1, x2)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, *rest):
+        out = jnp.einsum("bm,omn,bn->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+    args = (x1, x2, weight) + ((bias,) if bias is not None else ())
+    return apply("bilinear", f, *args)
+
+
+pad = _pad_op
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return _pad_op(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format="NCHW", name=None):
+    """Resize (reference `nn/functional/common.py` interpolate).  Supports
+    nearest/bilinear/bicubic/trilinear/area/linear over NCHW/NHWC layouts via
+    jax.image.resize (XLA-fused gather path)."""
+    data = _to_data(x)
+    nd = data.ndim
+    channel_last = data_format in ("NHWC", "NDHWC", "NLC")
+    spatial = nd - 2
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = [int(v) for v in np.asarray(size._data)]
+        out_sp = [int(v.item()) if isinstance(v, Tensor) else int(v) for v in
+                  (size if isinstance(size, (list, tuple)) else [size] * spatial)]
+    else:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * spatial
+        in_sp = data.shape[1:-1] if channel_last else data.shape[2:]
+        out_sp = [int(s * f) for s, f in zip(in_sp, scale_factor)]
+
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+
+    def f(a):
+        if channel_last:
+            shape = (a.shape[0],) + tuple(out_sp) + (a.shape[-1],)
+        else:
+            shape = a.shape[:2] + tuple(out_sp)
+        if jmode == "nearest":
+            return jax.image.resize(a, shape, method="nearest")
+        if align_corners:
+            # align_corners resize: explicit coordinate map via linear interp per axis
+            return _resize_align_corners(a, shape, jmode, channel_last)
+        return jax.image.resize(a, shape, method=jmode)
+    return apply("interpolate", f, x)
+
+
+def _resize_align_corners(a, shape, method, channel_last):
+    nd = a.ndim
+    sp_axes = list(range(1, nd - 1)) if channel_last else list(range(2, nd))
+    out = a
+    for ax in sp_axes:
+        n_in = out.shape[ax]
+        n_out = shape[ax]
+        if n_in == n_out:
+            continue
+        if n_out == 1:
+            idx_lo = jnp.zeros((1,), jnp.int32)
+            idx_hi = idx_lo
+            w = jnp.zeros((1,), out.dtype)
+        else:
+            pos = jnp.arange(n_out, dtype=jnp.float32) * (n_in - 1) / (n_out - 1)
+            idx_lo = jnp.floor(pos).astype(jnp.int32)
+            idx_hi = jnp.minimum(idx_lo + 1, n_in - 1)
+            w = (pos - idx_lo).astype(out.dtype)
+        lo = jnp.take(out, idx_lo, axis=ax)
+        hi = jnp.take(out, idx_hi, axis=ax)
+        bshape = [1] * out.ndim
+        bshape[ax] = n_out
+        w = w.reshape(bshape)
+        out = lo * (1 - w) + hi * w
+    return out
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference phi `unfold` kernel)."""
+    def pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    kh, kw = pair(kernel_sizes)
+    sh, sw = pair(strides)
+    ph, pw = pair(paddings) if not (isinstance(paddings, (list, tuple)) and len(paddings) == 4) else (paddings[0], paddings[1])
+    dh, dw = pair(dilations)
+
+    def f(a):
+        n, c, h, w = a.shape
+        a2 = jnp.pad(a, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+        out_h = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        out_w = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        patches = jax.lax.conv_general_dilated_patches(
+            a2, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return patches.reshape(n, c * kh * kw, out_h * out_w)
+    return apply("unfold", f, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    oh, ow = pair(output_sizes)
+    kh, kw = pair(kernel_sizes)
+    sh, sw = pair(strides)
+    ph, pw = pair(paddings)
+    dh, dw = pair(dilations)
+
+    def f(a):
+        n, ckk, l = a.shape
+        c = ckk // (kh * kw)
+        out_h = (oh + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        out_w = (ow + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        cols = a.reshape(n, c, kh, kw, out_h, out_w)
+        out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), a.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                hi = i * dh
+                wj = j * dw
+                out = out.at[:, :, hi:hi + sh * out_h:sh, wj:wj + sw * out_w:sw].add(
+                    cols[:, :, i, j])
+        return out[:, :, ph:ph + oh, pw:pw + ow]
+    return apply("fold", f, x)

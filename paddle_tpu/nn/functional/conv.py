@@ -1,0 +1,159 @@
+"""Convolutions (reference: `python/paddle/nn/functional/conv.py`, phi conv kernels).
+
+All variants lower to `lax.conv_general_dilated` / `lax.conv_transpose` — a single MXU
+path XLA tiles onto the systolic array, replacing the reference's cuDNN dispatch.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import apply
+
+
+def _tup(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(int(x) for x in v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+def _padding(padding, n, strides, dilations, ksize):
+    """Normalise paddle padding spec -> lax padding list or string."""
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    strides = _tup(stride, n)
+    dilations = _tup(dilation, n)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC", "NWC")
+    if n == 1:
+        dn = ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    elif n == 2:
+        dn = ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    else:
+        dn = ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+    pad = _padding(padding, n, strides, dilations, None)
+
+    def f(a, w, *rest):
+        # paddle weights are [out, in/groups, *k]; lax wants layout per dn[1]
+        if channel_last:
+            # OIHW... -> HWIO...
+            perm = tuple(range(2, 2 + n)) + (1, 0)
+            w = jnp.transpose(w, perm)
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad, rhs_dilation=dilations,
+            dimension_numbers=dn, feature_group_count=groups)
+        if rest:
+            b = rest[0]
+            bshape = [1] * out.ndim
+            bshape[-1 if channel_last else 1] = b.size
+            out = out + b.reshape(bshape)
+        return out
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return apply(f"conv{n}d", f, *args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC",) else "NCW"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, df)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups,
+                    n, data_format, output_size):
+    strides = _tup(stride, n)
+    dilations = _tup(dilation, n)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC", "NWC")
+    if n == 1:
+        dn = ("NWC", "WIO", "NWC") if channel_last else ("NCW", "IOW", "NCW")
+    elif n == 2:
+        dn = ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "IOHW", "NCHW")
+    else:
+        dn = ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "IODHW", "NCDHW")
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        pad = _padding(padding, n, strides, dilations, None)
+    opad = _tup(output_padding, n) if output_padding else (0,) * n
+
+    def f(a, w, *rest):
+        # paddle transpose-conv weights: [in, out/groups, *k] (IO layout)
+        if channel_last:
+            perm = tuple(range(2, 2 + n)) + (0, 1)  # IOHW -> HWIO
+            wt = jnp.transpose(w, perm)
+        else:
+            wt = w
+        if groups > 1:
+            # grouped transpose conv: split and concat (cold path)
+            a_groups = jnp.split(a, groups, axis=-1 if channel_last else 1)
+            w_groups = jnp.split(wt, groups, axis=-2 if channel_last else 0)
+            outs = [_transpose_one(ag, wg, strides, pad, dilations, dn, opad)
+                    for ag, wg in zip(a_groups, w_groups)]
+            out = jnp.concatenate(outs, axis=-1 if channel_last else 1)
+        else:
+            out = _transpose_one(a, wt, strides, pad, dilations, dn, opad)
+        if rest:
+            b = rest[0]
+            bshape = [1] * out.ndim
+            bshape[-1 if channel_last else 1] = b.size
+            out = out + b.reshape(bshape)
+        return out
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return apply(f"conv{n}d_transpose", f, *args)
+
+
+def _transpose_one(a, w, strides, pad, dilations, dn, opad):
+    if isinstance(pad, str):
+        lax_pad = pad
+    else:
+        # paddle conv_transpose padding p means: out = (in-1)*s - 2p + k; lax
+        # conv_transpose with padding list interprets as output cropping
+        k_axes = [i for i, ch in enumerate(dn[1]) if ch not in ("I", "O")]
+        ks = [w.shape[i] for i in k_axes]
+        lax_pad = [(d * (k - 1) - p[0], d * (k - 1) - p[1] + op)
+                   for k, p, d, op in zip(ks, pad, dilations, opad)]
+    return jax.lax.conv_transpose(a, w, strides=strides, padding=lax_pad,
+                                  rhs_dilation=dilations, dimension_numbers=dn,
+                                  transpose_kernel=True)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, 1, df, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCHW", output_size=None, name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCDHW", output_size=None, name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, 3, data_format, output_size)

@@ -1,0 +1,14 @@
+"""Distance functionals (reference: `python/paddle/nn/functional/distance.py`)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import apply
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def f(a, b):
+        d = a - b + epsilon
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p), axis=-1, keepdims=keepdim),
+                         1.0 / p)
+    return apply("pairwise_distance", f, x, y)

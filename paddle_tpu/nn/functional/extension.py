@@ -1,0 +1,52 @@
+"""Extension functionals (reference: `python/paddle/nn/functional/extension.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import apply
+from ...ops.creation import diag_embed  # noqa: F401  (re-export, paddle places it here)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from ...core import dtype as _dt
+
+    def f(lens):
+        m = maxlen if maxlen is not None else int(lens.max())
+        return (jnp.arange(m)[None, :] < lens[..., None]).astype(_dt.to_np(dtype))
+    return apply("sequence_mask", f, x)
+
+
+def gather_tree(ids, parents):
+    def f(step_ids, parent_ids):
+        T, B, W = step_ids.shape
+
+        def body(carry, t):
+            beams = carry
+            new_beams = jnp.take_along_axis(parent_ids[t], beams, axis=-1)
+            tokens = jnp.take_along_axis(step_ids[t], beams, axis=-1)
+            return new_beams, tokens
+
+        init = jnp.tile(jnp.arange(W)[None, :], (B, 1))
+        _, toks = jax.lax.scan(body, init, jnp.arange(T - 1, -1, -1))
+        return jnp.flip(toks, axis=0)
+    return apply("gather_tree", f, ids, parents)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    def f(a):
+        if data_format == "NHWC":
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate([v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], axis=1)
+        right = jnp.concatenate([jnp.zeros_like(v[:, :1, fold:2 * fold]),
+                                 v[:, :-1, fold:2 * fold]], axis=1)
+        rest = v[:, :, 2 * fold:]
+        out = jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+    return apply("temporal_shift", f, x)

@@ -1,0 +1,51 @@
+"""Flash attention API (reference: `python/paddle/nn/functional/flash_attention.py`).
+
+The reference wraps the flashattn CUDA library; here the hot path is a Pallas TPU
+flash-attention kernel (`paddle_tpu/incubate/kernels/flash_attention.py`) with an XLA
+fallback on CPU.  Layout: [batch, seqlen, nheads, headdim] exactly like the reference.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False,
+                    fixed_seed_offset=None, rng_name="", training=True, name=None):
+    from ...incubate.nn.functional import fused_dot_product_attention
+    out = fused_dot_product_attention(query, key, value, attn_mask=None,
+                                      dropout_p=dropout, is_causal=causal,
+                                      training=training)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
+                        max_seqlen_k, scale, dropout=0.0, causal=False,
+                        return_softmax=False, fixed_seed_offset=None, rng_name="",
+                        training=True, name=None):
+    """Varlen flash attention: total-token packed layout [total, H, D] with cumulative
+    sequence offsets (reference `flash_attn_unpadded`).  Implemented by segment-masked
+    attention over the packed dimension — static shapes, so it stays jittable."""
+    def f(q, k, v, cu_q, cu_k):
+        total_q = q.shape[0]
+        total_k = k.shape[0]
+        # segment id per token from cumulative offsets
+        seg_q = jnp.searchsorted(cu_q[1:], jnp.arange(total_q), side="right")
+        seg_k = jnp.searchsorted(cu_k[1:], jnp.arange(total_k), side="right")
+        scores = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * scale
+        mask = seg_q[:, None] == seg_k[None, :]
+        if causal:
+            pos_q = jnp.arange(total_q) - jnp.take(cu_q, seg_q)
+            pos_k = jnp.arange(total_k) - jnp.take(cu_k, seg_k)
+            mask = mask & (pos_q[:, None] >= pos_k[None, :])
+        scores = jnp.where(mask[None], scores, -1e30)
+        p = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
+        p = p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+        out = jnp.einsum("hqk,khd->qhd", p, v.astype(jnp.float32))
+        return out.astype(q.dtype)
+    out = apply("flash_attn_unpadded", f, query, key, value, cu_seqlens_q, cu_seqlens_k)
+    return out, None

@@ -1,0 +1,25 @@
+"""Input functionals (reference: `python/paddle/nn/functional/input.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import apply
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Embedding lookup (reference phi `embedding` kernel; `sparse` selects
+    SelectedRows grad in the reference — here grads are dense scatter-adds, which is the
+    XLA-native form)."""
+    def f(ids, w):
+        out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+        if padding_idx is not None and padding_idx >= 0:
+            mask = (ids == padding_idx)
+            out = jnp.where(mask[..., None], 0.0, out)
+        return out
+    return apply("embedding", f, x, weight)
+
+
+def one_hot(x, num_classes, name=None):
+    return apply("one_hot", lambda a: jax.nn.one_hot(a.astype(jnp.int32), num_classes,
+                                                     dtype=jnp.float32), x)

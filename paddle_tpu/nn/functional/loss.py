@@ -1,0 +1,309 @@
+"""Loss functionals (reference: `python/paddle/nn/functional/loss.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply, _to_data
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    """Softmax cross entropy (reference phi `cross_entropy_with_softmax` kernel).
+
+    Log-softmax + gather formulation: numerically stable and XLA fuses it into the
+    preceding matmul's epilogue.
+    """
+    def f(logits, lab, *rest):
+        w = rest[0] if rest else None
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis) if use_softmax \
+            else jnp.log(jnp.maximum(logits.astype(jnp.float32), 1e-30))
+        if soft_label or (lab.dtype in (jnp.float32, jnp.float16, jnp.bfloat16)
+                          and lab.shape == logits.shape):
+            sl = lab.astype(jnp.float32)
+            if label_smoothing > 0.0:
+                k = logits.shape[axis]
+                sl = sl * (1 - label_smoothing) + label_smoothing / k
+            loss = -jnp.sum(sl * lp, axis=axis)
+        else:
+            li = lab.astype(jnp.int32)
+            squeeze = li.ndim == lp.ndim and li.shape[axis] == 1
+            if squeeze:
+                li = jnp.squeeze(li, axis)
+            if label_smoothing > 0.0:
+                k = logits.shape[axis]
+                onehot = jax.nn.one_hot(li, k, axis=axis, dtype=jnp.float32)
+                sl = onehot * (1 - label_smoothing) + label_smoothing / k
+                loss = -jnp.sum(sl * lp, axis=axis)
+            else:
+                safe = jnp.where(li == ignore_index, 0, li)
+                loss = -jnp.take_along_axis(lp, jnp.expand_dims(safe, axis), axis=axis)
+                loss = jnp.squeeze(loss, axis)
+            mask = (li != ignore_index)
+            loss = jnp.where(mask, loss, 0.0)
+            if w is not None:
+                wv = jnp.take(w.astype(jnp.float32), jnp.where(li == ignore_index, 0, li))
+                wv = jnp.where(mask, wv, 0.0)
+                loss = loss * wv
+                if reduction == "mean":
+                    return jnp.sum(loss) / jnp.maximum(jnp.sum(wv), 1e-12)
+            if reduction == "mean":
+                denom = jnp.maximum(jnp.sum(mask.astype(jnp.float32)), 1.0)
+                return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply("cross_entropy", f, *args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    def f(lg, lab):
+        sm = jax.nn.softmax(lg.astype(jnp.float32), axis=axis)
+        lp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=axis)
+        if soft_label:
+            loss = -jnp.sum(lab.astype(jnp.float32) * lp, axis=axis, keepdims=True)
+        else:
+            li = lab.astype(jnp.int32)
+            if li.ndim == lp.ndim and li.shape[axis] == 1:
+                gather_idx = li
+            else:
+                gather_idx = jnp.expand_dims(li, axis)
+            safe = jnp.where(gather_idx == ignore_index, 0, gather_idx)
+            loss = -jnp.take_along_axis(lp, safe, axis=axis)
+            loss = jnp.where(gather_idx == ignore_index, 0.0, loss)
+        if return_softmax:
+            return loss.astype(lg.dtype), sm.astype(lg.dtype)
+        return loss.astype(lg.dtype)
+    return apply("softmax_with_cross_entropy", f, logits, label)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def f(lp, lab, *rest):
+        li = lab.astype(jnp.int32)
+        safe = jnp.where(li == ignore_index, 0, li)
+        loss = -jnp.take_along_axis(lp, jnp.expand_dims(safe, 1), axis=1).squeeze(1)
+        mask = (li != ignore_index).astype(jnp.float32)
+        if rest:
+            wv = jnp.take(rest[0], safe) * mask
+            loss = loss * wv
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(wv), 1e-12)
+        else:
+            loss = loss * mask
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1.0)
+        return _reduce(loss, reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply("nll_loss", f, *args)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply("mse_loss", lambda a, b: _reduce(jnp.square(a - b), reduction),
+                 input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply("l1_loss", lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(loss, reduction)
+    return apply("smooth_l1_loss", f, input, label)
+
+
+def square_error_cost(input, label):
+    return apply("square_error_cost", lambda a, b: jnp.square(a - b), input, label)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def f(p, y, *rest):
+        p32 = jnp.clip(p.astype(jnp.float32), 1e-12, 1.0 - 1e-7)
+        loss = -(y * jnp.log(p32) + (1 - y) * jnp.log1p(-p32))
+        if rest:
+            loss = loss * rest[0]
+        return _reduce(loss, reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply("binary_cross_entropy", f, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def f(z, y, *rest):
+        it = iter(rest)
+        w = next(it) if weight is not None else None
+        pw = next(it) if pos_weight is not None else None
+        z32 = z.astype(jnp.float32)
+        y32 = y.astype(jnp.float32)
+        # stable: max(z,0) - z*y + log(1+exp(-|z|)); pos_weight scales positive term
+        if pw is None:
+            loss = jnp.maximum(z32, 0) - z32 * y32 + jnp.log1p(jnp.exp(-jnp.abs(z32)))
+        else:
+            log_w = 1 + (pw - 1) * y32
+            loss = (1 - y32) * z32 + log_w * (jnp.log1p(jnp.exp(-jnp.abs(z32)))
+                                              + jnp.maximum(-z32, 0))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    args = [logit, label]
+    if weight is not None:
+        args.append(weight)
+    if pos_weight is not None:
+        args.append(pos_weight)
+    return apply("bce_with_logits", f, *args)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def f(lp, y):
+        if log_target:
+            loss = jnp.exp(y) * (y - lp)
+        else:
+            loss = jnp.where(y > 0, y * (jnp.log(jnp.maximum(y, 1e-30)) - lp), 0.0)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / lp.shape[0]
+        return _reduce(loss, reduction)
+    return apply("kl_div", f, input, label)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def f(p, y):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon)
+    return apply("log_loss", f, input, label)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def f(a, y):
+        loss = jnp.where(y == 1.0, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+    return apply("hinge_embedding_loss", f, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    def f(a, b, y):
+        loss = jnp.maximum(0.0, -y * (a - b) + margin)
+        return _reduce(loss, reduction)
+    return apply("margin_ranking_loss", f, input, other, label)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return apply("cosine_embedding_loss", f, input1, input2, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
+                        swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        def dist(u, v):
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(u - v) + epsilon, p), axis=-1), 1.0 / p)
+        d_ap = dist(a, pos)
+        d_an = dist(a, neg)
+        if swap:
+            d_pn = dist(pos, neg)
+            d_an = jnp.minimum(d_an, d_pn)
+        return _reduce(jnp.maximum(0.0, d_ap - d_an + margin), reduction)
+    return apply("triplet_margin_loss", f, input, positive, negative)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean", name=None):
+    def f(z, y, *rest):
+        loss = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        loss = jnp.mean(loss, axis=-1)
+        if rest:
+            loss = loss * rest[0]
+        return _reduce(loss, reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply("multi_label_soft_margin_loss", f, *args)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def f(z, y):
+        return _reduce(jnp.log1p(jnp.exp(-y * z)), reduction)
+    return apply("soft_margin_loss", f, input, label)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(z, y, *rest):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if rest:
+            loss = loss / rest[0]
+        return _reduce(loss, reduction)
+    args = (logit, label) + ((normalizer,) if normalizer is not None else ())
+    return apply("sigmoid_focal_loss", f, *args)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def f(p, y):
+        yf = jax.nn.one_hot(y.squeeze(-1).astype(jnp.int32), p.shape[-1], dtype=p.dtype)
+        red = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * yf, axis=red)
+        union = jnp.sum(p, axis=red) + jnp.sum(yf, axis=red)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return apply("dice_loss", f, input, label)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def f(a, p, lab):
+        batch = a.shape[0]
+        logits = jnp.matmul(a, p.T)
+        same = (lab.reshape(-1, 1) == lab.reshape(1, -1)).astype(jnp.float32)
+        same = same / jnp.sum(same, axis=1, keepdims=True)
+        xent = jnp.mean(jnp.sum(-same * jax.nn.log_softmax(logits, axis=1), axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, axis=1))
+                        + jnp.mean(jnp.sum(p * p, axis=1))) * 0.25
+        return xent + reg
+    return apply("npair_loss", f, anchor, positive, labels)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def f(z, y):
+        if log_input:
+            loss = jnp.exp(z) - y * z
+        else:
+            loss = z - y * jnp.log(z + epsilon)
+        if full:
+            stirling = y * jnp.log(y + epsilon) - y + 0.5 * jnp.log(2 * jnp.pi * (y + epsilon))
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+    return apply("poisson_nll_loss", f, input, label)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via optax's implementation (log-space forward algorithm on XLA)."""
+    import optax
+
+    def f(lp, lab, il, ll):
+        # optax expects [B, T, V] logits and paddle gives [T, B, V]
+        logits = jnp.transpose(lp, (1, 0, 2)).astype(jnp.float32)
+        B, T, V = logits.shape
+        logitpad = jnp.arange(T)[None, :] >= il[:, None]
+        maxL = lab.shape[1]
+        labelpad = jnp.arange(maxL)[None, :] >= ll[:, None]
+        per = optax.ctc_loss(logits, logitpad.astype(jnp.float32),
+                             lab.astype(jnp.int32), labelpad.astype(jnp.float32),
+                             blank_id=blank)
+        if reduction == "mean":
+            return jnp.mean(per / jnp.maximum(ll.astype(jnp.float32), 1.0))
+        return _reduce(per, reduction)
+    return apply("ctc_loss", f, log_probs, labels, input_lengths, label_lengths)

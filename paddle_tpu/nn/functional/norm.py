@@ -1,0 +1,170 @@
+"""Normalization functionals (reference: `python/paddle/nn/functional/norm.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply, _to_data
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+    ns = (normalized_shape,) if isinstance(normalized_shape, int) else tuple(normalized_shape)
+    axes = tuple(range(-len(ns), 0))
+
+    def f(a, *rest):
+        mean = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = (a.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + epsilon)
+        out = out.astype(a.dtype)
+        it = iter(rest)
+        if weight is not None:
+            out = out * next(it)
+        if bias is not None:
+            out = out + next(it)
+        return out
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return apply("layer_norm", f, *args)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-05, data_format="NCHW", use_global_stats=None,
+               name=None):
+    """BatchNorm with running-stat update (reference phi `batch_norm` kernel).
+
+    Running stats update mutates the buffer tensors in place (matching the reference's
+    in-place MeanOut/VarianceOut); under `to_static` capture the mutation is traced as
+    functional state.
+    """
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC") or data_format == "NHWC"
+    use_stats = use_global_stats if use_global_stats is not None else not training
+
+    data = _to_data(x)
+    ch_axis = data.ndim - 1 if channel_last else (1 if data.ndim > 1 else 0)
+    red_axes = tuple(i for i in range(data.ndim) if i != ch_axis)
+
+    if not use_stats:
+        # compute batch stats and update running buffers in place
+        batch_mean = jnp.mean(data.astype(jnp.float32), axis=red_axes)
+        batch_var = jnp.var(data.astype(jnp.float32), axis=red_axes)
+        if isinstance(running_mean, Tensor):
+            running_mean._data = (momentum * running_mean._data
+                                  + (1 - momentum) * batch_mean).astype(running_mean._data.dtype)
+            running_var._data = (momentum * running_var._data
+                                 + (1 - momentum) * batch_var).astype(running_var._data.dtype)
+
+        def f(a, *rest):
+            m = jnp.mean(a.astype(jnp.float32), axis=red_axes)
+            v = jnp.var(a.astype(jnp.float32), axis=red_axes)
+            shape = [1] * a.ndim
+            shape[ch_axis] = a.shape[ch_axis]
+            out = (a.astype(jnp.float32) - m.reshape(shape)) * jax.lax.rsqrt(v.reshape(shape) + epsilon)
+            out = out.astype(a.dtype)
+            it = iter(rest)
+            if weight is not None:
+                out = out * next(it).reshape(shape)
+            if bias is not None:
+                out = out + next(it).reshape(shape)
+            return out
+        args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+        return apply("batch_norm", f, *args)
+
+    def f(a, m, v, *rest):
+        shape = [1] * a.ndim
+        shape[ch_axis] = a.shape[ch_axis]
+        out = (a.astype(jnp.float32) - m.astype(jnp.float32).reshape(shape)) \
+            * jax.lax.rsqrt(v.astype(jnp.float32).reshape(shape) + epsilon)
+        out = out.astype(a.dtype)
+        it = iter(rest)
+        if weight is not None:
+            out = out * next(it).reshape(shape)
+        if bias is not None:
+            out = out + next(it).reshape(shape)
+        return out
+    args = (x, running_mean, running_var) + tuple(t for t in (weight, bias) if t is not None)
+    return apply("batch_norm", f, *args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-05, data_format="NCHW",
+                  name=None):
+    def f(a, *rest):
+        red = tuple(range(2, a.ndim))
+        m = jnp.mean(a.astype(jnp.float32), axis=red, keepdims=True)
+        v = jnp.var(a.astype(jnp.float32), axis=red, keepdims=True)
+        out = ((a.astype(jnp.float32) - m) * jax.lax.rsqrt(v + eps)).astype(a.dtype)
+        shape = [1] * a.ndim
+        shape[1] = a.shape[1]
+        it = iter(rest)
+        if weight is not None:
+            out = out * next(it).reshape(shape)
+        if bias is not None:
+            out = out + next(it).reshape(shape)
+        return out
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return apply("instance_norm", f, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None, data_format="NCHW",
+               name=None):
+    channel_last = data_format.endswith("C") and data_format != "NC"
+
+    def f(a, *rest):
+        if channel_last:
+            a_cf = jnp.moveaxis(a, -1, 1)
+        else:
+            a_cf = a
+        n, c = a_cf.shape[0], a_cf.shape[1]
+        g = num_groups
+        grouped = a_cf.reshape((n, g, c // g) + a_cf.shape[2:])
+        red = tuple(range(2, grouped.ndim))
+        m = jnp.mean(grouped.astype(jnp.float32), axis=red, keepdims=True)
+        v = jnp.var(grouped.astype(jnp.float32), axis=red, keepdims=True)
+        out = ((grouped.astype(jnp.float32) - m) * jax.lax.rsqrt(v + epsilon))
+        out = out.reshape(a_cf.shape).astype(a.dtype)
+        shape = [1] * a_cf.ndim
+        shape[1] = c
+        it = iter(rest)
+        if weight is not None:
+            out = out * next(it).reshape(shape)
+        if bias is not None:
+            out = out + next(it).reshape(shape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return apply("group_norm", f, *args)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(a):
+        if p == 2:
+            nrm = jnp.sqrt(jnp.sum(a * a, axis=axis, keepdims=True))
+        else:
+            nrm = jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=axis, keepdims=True), 1.0 / p)
+        return a / jnp.maximum(nrm, epsilon)
+    return apply("normalize", f, x)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW",
+                        name=None):
+    def f(a):
+        sq = jnp.square(a)
+        half = size // 2
+        c_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        sq_cf = jnp.moveaxis(sq, c_axis, 0)
+        c = sq_cf.shape[0]
+        padded = jnp.pad(sq_cf, [(half, size - half - 1)] + [(0, 0)] * (sq_cf.ndim - 1))
+        acc = jnp.zeros_like(sq_cf)
+        for i in range(size):
+            acc = acc + padded[i:i + c]
+        acc = jnp.moveaxis(acc, 0, c_axis)
+        return a / jnp.power(k + alpha * acc / size, beta)
+    return apply("local_response_norm", f, x)
+
+
+def rms_norm(x, weight, epsilon=1e-6, name=None):
+    """RMSNorm functional — fused path lives in incubate (Pallas kernel)."""
+    def f(a, w):
+        var = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1, keepdims=True)
+        return (a.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype) * w
+    return apply("rms_norm", f, x, weight)

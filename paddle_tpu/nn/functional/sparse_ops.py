@@ -1,0 +1,23 @@
+"""Fused softmax-mask ops (reference: `incubate/softmax_mask_fuse*`, phi
+`fused_softmax_mask_kernel.cu`) — on TPU these are single XLA fusions."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import apply
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    return apply("softmax_mask_fuse",
+                 lambda a, m: jax.nn.softmax(a.astype(jnp.float32) + m.astype(jnp.float32),
+                                             axis=-1).astype(a.dtype), x, mask)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    def f(a):
+        L = a.shape[-1]
+        mask = jnp.tril(jnp.ones((L, L), bool))
+        scores = jnp.where(mask, a.astype(jnp.float32), -1e30)
+        return jax.nn.softmax(scores, axis=-1).astype(a.dtype)
+    return apply("softmax_mask_fuse_upper_triangle", f, x)

@@ -1,0 +1,124 @@
+"""Vision functionals (reference: `python/paddle/nn/functional/vision.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import apply
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            out = a.reshape(n, c // (r * r), r, r, h, w)
+            out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+            return out.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        out = a.reshape(n, h, w, r, r, c // (r * r))
+        out = jnp.transpose(out, (0, 1, 3, 2, 4, 5))
+        return out.reshape(n, h * r, w * r, c // (r * r))
+    return apply("pixel_shuffle", f, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            out = a.reshape(n, c, h // r, r, w // r, r)
+            out = jnp.transpose(out, (0, 1, 3, 5, 2, 4))
+            return out.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        out = a.reshape(n, h // r, r, w // r, r, c)
+        out = jnp.transpose(out, (0, 1, 3, 2, 4, 5))
+        return out.reshape(n, h // r, w // r, c * r * r)
+    return apply("pixel_unshuffle", f, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            out = a.reshape(n, groups, c // groups, h, w)
+            out = jnp.transpose(out, (0, 2, 1, 3, 4))
+            return out.reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        out = a.reshape(n, h, w, groups, c // groups)
+        out = jnp.transpose(out, (0, 1, 2, 4, 3))
+        return out.reshape(n, h, w, c)
+    return apply("channel_shuffle", f, x)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    def f(th):
+        n, _, _ = th.shape
+        if len(out_shape) == 4:
+            _, _, h, w = out_shape
+            if align_corners:
+                ys = jnp.linspace(-1, 1, h)
+                xs = jnp.linspace(-1, 1, w)
+            else:
+                ys = (jnp.arange(h) + 0.5) * 2 / h - 1
+                xs = (jnp.arange(w) + 0.5) * 2 / w - 1
+            gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+            ones = jnp.ones_like(gx)
+            base = jnp.stack([gx, gy, ones], axis=-1)  # [H, W, 3]
+            out = jnp.einsum("hwk,nck->nhwc", base.astype(th.dtype), th)
+            return out
+        raise NotImplementedError("5-D affine_grid")
+    return apply("affine_grid", f, theta)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=True,
+                name=None):
+    def f(a, g):
+        n, c, h, w = a.shape
+        gx = g[..., 0]
+        gy = g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def sample(img, yy, xx):
+            # img [C,H,W]; yy,xx [Ho,Wo] float
+            if padding_mode == "border":
+                yy = jnp.clip(yy, 0, h - 1)
+                xx = jnp.clip(xx, 0, w - 1)
+            y0 = jnp.floor(yy).astype(jnp.int32)
+            x0 = jnp.floor(xx).astype(jnp.int32)
+            if mode == "nearest":
+                yi = jnp.clip(jnp.round(yy).astype(jnp.int32), 0, h - 1)
+                xi = jnp.clip(jnp.round(xx).astype(jnp.int32), 0, w - 1)
+                out = img[:, yi, xi]
+                if padding_mode == "zeros":
+                    valid = (yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1)
+                    out = jnp.where(valid[None], out, 0.0)
+                return out
+            y1 = y0 + 1
+            x1 = x0 + 1
+            wy = yy - y0
+            wx = xx - x0
+
+            def at(yi, xi):
+                yc = jnp.clip(yi, 0, h - 1)
+                xc = jnp.clip(xi, 0, w - 1)
+                v = img[:, yc, xc]
+                if padding_mode == "zeros":
+                    valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+                    v = jnp.where(valid[None], v, 0.0)
+                return v
+            out = (at(y0, x0) * ((1 - wy) * (1 - wx))[None]
+                   + at(y0, x1) * ((1 - wy) * wx)[None]
+                   + at(y1, x0) * (wy * (1 - wx))[None]
+                   + at(y1, x1) * (wy * wx)[None])
+            return out
+        out = jax.vmap(sample)(a, fy, fx)
+        return out.astype(a.dtype)
+    return apply("grid_sample", f, x, grid)

@@ -1,0 +1,285 @@
+"""RNN layers (reference: `python/paddle/nn/layer/rnn.py` — SimpleRNN/LSTM/GRU + cells).
+
+TPU-native design: the time loop is a `lax.scan` inside one traced op, so the whole
+sequence compiles to a single fused XLA while-loop (the reference dispatches per-step
+kernels or cuDNN). Parameters follow paddle layout: weight_ih [gates*H, I],
+weight_hh [gates*H, H].
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor, apply
+from .. import functional as F
+from ..initializer import Uniform
+from .layers import Layer
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0,
+                           batch_dim_idx=0):
+        from ...ops.creation import full
+        B = batch_ref.shape[batch_dim_idx]
+        st = self.state_shape
+        if isinstance(st[0], (list, tuple)):
+            return tuple(full([B] + list(s), init_value) for s in st)
+        return full([B] + list(st), init_value)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr, is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr, is_bias=True,
+                                             default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def f(x, h, wi, wh, bi, bh):
+            out = act(x @ wi.T + bi + h @ wh.T + bh)
+            return out
+        h = apply("simple_rnn_cell", f, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None,
+                 proj_size=0):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h0, c0 = states
+
+        def f(x, h, c, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, fg, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            fg = jax.nn.sigmoid(fg)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            c_new = fg * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+        h, c = apply("lstm_cell", f, inputs, h0, c0, self.weight_ih, self.weight_hh,
+                     self.bias_ih, self.bias_hh)
+        return h, (h, c)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def f(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            return (1 - z) * c + z * h
+        h = apply("gru_cell", f, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class RNN(Layer):
+    """Wraps a cell into a sequence scan (reference `nn/layer/rnn.py` RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None, **kwargs):
+        # python-level scan over Tensor ops (keeps tape semantics in eager)
+        from ...ops.manipulation import stack
+        axis = 0 if self.time_major else 1
+        steps = inputs.shape[axis]
+        states = initial_states
+        outs = []
+        rng = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        for tstep in rng:
+            x = inputs[:, tstep] if axis == 1 else inputs[tstep]
+            out, states = self.cell(x, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        seq = stack(outs, axis=axis)
+        return seq, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.manipulation import concat
+        st_fw, st_bw = (initial_states if initial_states is not None else (None, None))
+        out_fw, s_fw = self.rnn_fw(inputs, st_fw, sequence_length)
+        out_bw, s_bw = self.rnn_bw(inputs, st_bw, sequence_length)
+        return concat([out_fw, out_bw], axis=-1), (s_fw, s_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (bi)directional RNN driven by lax.scan for the jit path."""
+
+    MODE = "RNN_TANH"
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, proj_size=0):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        num_dir = 2 if self.bidirect else 1
+        cell_cls = {"LSTM": LSTMCell, "GRU": GRUCell,
+                    "RNN_TANH": SimpleRNNCell, "RNN_RELU": SimpleRNNCell}[mode]
+        from .container import LayerList
+        cells = []
+        for layer in range(num_layers):
+            isz = input_size if layer == 0 else hidden_size * num_dir
+            kw = {}
+            if mode == "RNN_RELU":
+                kw["activation"] = "relu"
+            cells.append(cell_cls(isz, hidden_size, weight_ih_attr, weight_hh_attr,
+                                  bias_ih_attr, bias_hh_attr, **kw))
+            if self.bidirect:
+                cells.append(cell_cls(isz, hidden_size, weight_ih_attr, weight_hh_attr,
+                                      bias_ih_attr, bias_hh_attr, **kw))
+        self.cells = LayerList(cells)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.manipulation import concat, stack
+        num_dir = 2 if self.bidirect else 1
+        B_axis = 1 if self.time_major else 0
+        B = inputs.shape[B_axis]
+        if initial_states is None:
+            from ...ops.creation import zeros
+            if self.mode == "LSTM":
+                h0 = zeros([self.num_layers * num_dir, B, self.hidden_size])
+                c0 = zeros([self.num_layers * num_dir, B, self.hidden_size])
+                initial_states = (h0, c0)
+            else:
+                initial_states = zeros([self.num_layers * num_dir, B, self.hidden_size])
+
+        out = inputs
+        final_h, final_c = [], []
+        for layer in range(self.num_layers):
+            dir_outs = []
+            for d in range(num_dir):
+                idx = layer * num_dir + d
+                cell = self.cells[idx]
+                if self.mode == "LSTM":
+                    st = (initial_states[0][idx], initial_states[1][idx])
+                else:
+                    st = initial_states[idx]
+                rnn = RNN(cell, is_reverse=(d == 1), time_major=self.time_major)
+                o, s = rnn(out, st)
+                dir_outs.append(o)
+                if self.mode == "LSTM":
+                    final_h.append(s[0])
+                    final_c.append(s[1])
+                else:
+                    final_h.append(s)
+            out = dir_outs[0] if num_dir == 1 else concat(dir_outs, axis=-1)
+            if self.dropout > 0 and layer < self.num_layers - 1:
+                out = F.dropout(out, self.dropout, training=self.training)
+        h = stack(final_h, axis=0)
+        if self.mode == "LSTM":
+            c = stack(final_c, axis=0)
+            return out, (h, c)
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        mode = "RNN_RELU" if activation == "relu" else "RNN_TANH"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, proj_size=0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)

@@ -1,0 +1,80 @@
+"""nn.utils (reference: `python/paddle/nn/utils/`)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g._data)) for g in grads]))
+    else:
+        total = jnp.power(sum(jnp.sum(jnp.power(jnp.abs(g._data.astype(jnp.float32)),
+                                                norm_type)) for g in grads),
+                          1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError("non-finite grad norm")
+    clip_coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for g in grads:
+        g._data = (g._data * clip_coef).astype(g._data.dtype)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._data = jnp.clip(p.grad._data, -clip_value, clip_value)
+
+
+def parameters_to_vector(parameters, name=None):
+    return Tensor(jnp.concatenate([p._data.reshape(-1) for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = p._data.size
+        p._data = vec._data[offset:offset + n].reshape(p._data.shape).astype(p._data.dtype)
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Weight normalization reparameterization (cold path: recompute on access)."""
+    w = getattr(layer, name)
+    from ..initializer import Assign
+    g_data = jnp.linalg.norm(np.asarray(w._data).reshape(w._data.shape[dim], -1)
+                             if dim == 0 else np.moveaxis(np.asarray(w._data), dim, 0)
+                             .reshape(w._data.shape[dim], -1), axis=1)
+    from ...core.tensor import Parameter
+    layer.add_parameter(name + "_g", Parameter(jnp.asarray(g_data)))
+    layer.add_parameter(name + "_v", Parameter(w._data))
+    del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        v = lyr._parameters[name + "_v"]
+        g = lyr._parameters[name + "_g"]
+        vm = jnp.moveaxis(v._data, dim, 0)
+        norm = jnp.linalg.norm(vm.reshape(vm.shape[0], -1), axis=1)
+        shape = [-1] + [1] * (v._data.ndim - 1)
+        new_w = jnp.moveaxis(vm / norm.reshape(shape) * g._data.reshape(shape), 0, dim)
+        object.__setattr__(lyr, "_wn_cache", Tensor(new_w, stop_gradient=True))
+        lyr.__dict__[name] = lyr._wn_cache
+    layer.register_forward_pre_hook(hook)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
+    return layer

@@ -1,0 +1,1 @@
+from . import creation, einsum, linalg, logic, manipulation, math, random, search, stat  # noqa

@@ -1,0 +1,170 @@
+"""Tensor creation ops (reference: `python/paddle/tensor/creation.py`)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as _dt
+from ..core.tensor import Tensor, apply, to_tensor, _to_data
+
+
+def _npd(dtype, default=None):
+    d = _dt.to_np(dtype) if dtype is not None else None
+    if d is None and default is not None:
+        d = _dt.to_np(default)
+    return d
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _npd(dtype, _dt._default_dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _npd(dtype, _dt._default_dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fv = fill_value.item() if isinstance(fill_value, Tensor) else fill_value
+    if dtype is None:
+        dtype = _dt._default_dtype if isinstance(fv, float) else None
+    return Tensor(jnp.full(_shape(shape), fv, _npd(dtype)))
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros_like(_to_data(x), dtype=_npd(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones_like(_to_data(x), dtype=_npd(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full_like(_to_data(x), fill_value, dtype=_npd(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    start, end, step = val(start), val(end), val(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = _dt._default_dtype if any(isinstance(v, float) for v in (start, end, step)) else _dt.int64
+    return Tensor(jnp.arange(start, end, step, _npd(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return Tensor(jnp.linspace(val(start), val(stop), int(val(num)),
+                               dtype=_npd(dtype, _dt._default_dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(start, stop, int(num), base=base,
+                               dtype=_npd(dtype, _dt._default_dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows), None if num_columns is None else int(num_columns),
+                          dtype=_npd(dtype, _dt._default_dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    datas = [_to_data(a) for a in args]
+    outs = jnp.meshgrid(*datas, indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def f(a):
+        if a.ndim == 1:
+            out = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                mask = jnp.eye(*out.shape, k=offset, dtype=bool)
+                out = jnp.where(mask, out, padding_value)
+            return out
+        return jnp.diagonal(a, offset=offset)
+    return apply("diag", f, x)
+
+
+def diagflat(x, offset=0, name=None):
+    return apply("diagflat", lambda a: jnp.diagflat(a, k=offset), x)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def f(a):
+        n = a.shape[-1] + abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        if offset >= 0:
+            out = out.at[..., idx, idx + offset].set(a)
+        else:
+            out = out.at[..., idx - offset, idx].set(a)
+        if (dim1, dim2) != (-2, -1):
+            out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+        return out
+    return apply("diag_embed", f, x)
+
+
+def tril(x, diagonal=0, name=None):
+    return apply("tril", lambda a: jnp.tril(a, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    return apply("triu", lambda a: jnp.triu(a, k=diagonal), x)
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.stack([jnp.asarray(r), jnp.asarray(c)]).astype(_npd(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.stack([jnp.asarray(r), jnp.asarray(c)]).astype(_npd(dtype)))
+
+
+def assign(x, output=None):
+    data = _to_data(x)
+    if output is None:
+        return Tensor(data)
+    output._data = data.astype(output._data.dtype)
+    return output
+
+
+def clone(x, name=None):
+    return x.clone() if isinstance(x, Tensor) else Tensor(_to_data(x))
+
+
+def complex(real, imag, name=None):
+    return apply("complex", lambda r, i: r + 1j * i, real, imag)
+
+
+def polar(abs_t, angle, name=None):
+    return apply("polar", lambda a, th: a * jnp.exp(1j * th), abs_t, angle)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..core.tensor import Parameter
+    p = Parameter(jnp.zeros(_shape(shape), _npd(dtype)), name=name)
+    if default_initializer is not None:
+        default_initializer(p)
+    return p

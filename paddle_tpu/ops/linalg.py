@@ -1,0 +1,233 @@
+"""Linear algebra ops (reference: `python/paddle/tensor/linalg.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply, _to_data
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def f(a):
+        if axis is None:
+            if p in ("fro", 2):
+                return jnp.sqrt(jnp.sum(jnp.square(a)))
+            if p == "nuc":
+                return jnp.sum(jnp.linalg.svd(a, compute_uv=False))
+            if p == np.inf:
+                return jnp.max(jnp.abs(a))
+            if p == -np.inf:
+                return jnp.min(jnp.abs(a))
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(a), p)), 1.0 / p)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if isinstance(ax, tuple) and p == "fro":
+            return jnp.sqrt(jnp.sum(jnp.square(a), axis=ax, keepdims=keepdim))
+        if p == np.inf:
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == -np.inf:
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        pp = 2 if p == "fro" else p
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a), pp), axis=ax, keepdims=keepdim), 1.0 / pp)
+    return apply("norm", f, x)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p, axis, keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return apply("matrix_norm", lambda a: jnp.linalg.norm(a, ord=p, axis=tuple(axis), keepdims=keepdim), x)
+
+
+def dist(x, y, p=2, name=None):
+    return norm(x - y if isinstance(x, Tensor) else Tensor(_to_data(x)) - y, p)
+
+
+def cond(x, p=None, name=None):
+    return apply("cond", lambda a: jnp.linalg.cond(a, p=p), x)
+
+
+def dot(x, y, name=None):
+    return apply("dot", lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+    return apply("cholesky", f, x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, L):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+    return apply("cholesky_solve", f, x, y)
+
+
+def inverse(x, name=None):
+    return apply("inverse", jnp.linalg.inv, x)
+
+
+inv = inverse
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply("pinv", lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), x)
+
+
+def det(x, name=None):
+    return apply("det", jnp.linalg.det, x)
+
+
+def slogdet(x, name=None):
+    def f(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+    return apply("slogdet", f, x)
+
+
+def matrix_power(x, n, name=None):
+    return apply("matrix_power", lambda a: jnp.linalg.matrix_power(a, n), x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply("matrix_rank", lambda a: jnp.linalg.matrix_rank(a, tol), x)
+
+
+def qr(x, mode="reduced", name=None):
+    outs = apply("qr", lambda a: tuple(jnp.linalg.qr(a, mode=mode)), x)
+    return outs
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def f(a):
+        lu_, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_, piv.astype(jnp.int32) + 1  # paddle pivots are 1-based
+    outs = apply("lu", f, x)
+    if get_infos:
+        return outs[0], outs[1], Tensor(jnp.zeros((), jnp.int32))
+    return outs
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply("svd", lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)), x)
+
+
+def eig(x, name=None):
+    return apply("eig", lambda a: tuple(jnp.linalg.eig(a)), x)
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply("eigh", lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), x)
+
+
+def eigvals(x, name=None):
+    return apply("eigvals", jnp.linalg.eigvals, x)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply("eigvalsh", lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x)
+
+
+def solve(x, y, name=None):
+    return apply("solve", jnp.linalg.solve, x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply("triangular_solve", f, x, y)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def f(a, b):
+        sol, res, rank_, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank_.astype(jnp.int32), sv
+    return apply("lstsq", f, x, y)
+
+
+def multi_dot(x, name=None):
+    return apply("multi_dot", lambda *arrs: jnp.linalg.multi_dot(arrs), *x)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    from .math import matmul as _mm
+    return _mm(x, y, transpose_x, transpose_y)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    data = np.asarray(_to_data(x))
+    w = np.asarray(_to_data(weights)) if weights is not None else None
+    return Tensor(jnp.asarray(np.bincount(data, weights=w, minlength=minlength)))
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    data = np.asarray(_to_data(input))
+    lo, hi = (min, max) if (min != 0 or max != 0) else (data.min(), data.max())
+    hist, _ = np.histogram(data, bins=bins, range=(lo, hi))
+    return Tensor(jnp.asarray(hist.astype(np.int64)))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    data = np.asarray(_to_data(x))
+    w = np.asarray(_to_data(weights)) if weights is not None else None
+    hist, edges = np.histogramdd(data, bins=bins, range=ranges, density=density, weights=w)
+    return Tensor(jnp.asarray(hist)), [Tensor(jnp.asarray(e)) for e in edges]
+
+
+def householder_product(x, tau, name=None):
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(eye, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else eye
+        for i in range(n - 1, -1, -1):
+            v = a[..., :, i]
+            v = jnp.where(jnp.arange(m) < i, 0.0, v)
+            v = v.at[..., i].set(1.0)
+            ti = t[..., i][..., None, None]
+            q = q - ti * v[..., :, None] * jnp.einsum("...m,...mn->...n", v, q)[..., None, :].swapaxes(-1, -2).swapaxes(-1, -2)
+            q = q  # noqa
+        return q[..., :, :]
+    # simple reference implementation via loop (cold path)
+    def f2(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        for i in range(n - 1, -1, -1):
+            v = a[:, i]
+            v = jnp.where(jnp.arange(m) < i, 0.0, v).at[i].set(1.0)
+            q = q - t[i] * jnp.outer(v, v @ q)
+        return q
+    return apply("householder_product", f2, x, tau)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), x)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply("cov", lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0), x)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    def f(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(diff), p), axis=-1), 1.0 / p)
+    return apply("cdist", f, x, y)
+
+
+def pdist(x, p=2.0, name=None):
+    def f(a):
+        n = a.shape[0]
+        d = a[:, None, :] - a[None, :, :]
+        full = jnp.power(jnp.sum(jnp.power(jnp.abs(d), p), axis=-1), 1.0 / p) if p != 2.0 \
+            else jnp.sqrt(jnp.sum(d * d, axis=-1) + 1e-30)
+        iu = jnp.triu_indices(n, k=1)
+        return full[iu]
+    return apply("pdist", f, x)

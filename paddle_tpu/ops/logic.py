@@ -1,0 +1,58 @@
+"""Logical/comparison ops (reference: `python/paddle/tensor/logic.py`)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply, _to_data
+
+
+def _bin(name, jfn):
+    def op(x, y, out=None, name=None):
+        r = apply(nm, jfn, x, y)
+        if out is not None:
+            out._data = r._data
+            return out
+        return r
+    nm = name
+    op.__name__ = name
+    return op
+
+
+equal = _bin("equal", jnp.equal)
+not_equal = _bin("not_equal", jnp.not_equal)
+less_than = _bin("less_than", jnp.less)
+less_equal = _bin("less_equal", jnp.less_equal)
+greater_than = _bin("greater_than", jnp.greater)
+greater_equal = _bin("greater_equal", jnp.greater_equal)
+logical_and = _bin("logical_and", jnp.logical_and)
+logical_or = _bin("logical_or", jnp.logical_or)
+logical_xor = _bin("logical_xor", jnp.logical_xor)
+bitwise_and = _bin("bitwise_and", jnp.bitwise_and)
+bitwise_or = _bin("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _bin("bitwise_xor", jnp.bitwise_xor)
+
+
+def logical_not(x, out=None, name=None):
+    r = apply("logical_not", jnp.logical_not, x)
+    if out is not None:
+        out._data = r._data
+        return out
+    return r
+
+
+def bitwise_not(x, out=None, name=None):
+    return apply("bitwise_not", jnp.invert, x)
+
+
+def equal_all(x, y, name=None):
+    return apply("equal_all", lambda a, b: jnp.array_equal(a, b), x, y)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply("allclose", lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                                       equal_nan=equal_nan), x, y)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply("isclose", lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                                     equal_nan=equal_nan), x, y)

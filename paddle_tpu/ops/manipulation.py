@@ -1,0 +1,498 @@
+"""Shape/layout manipulation ops (reference: `python/paddle/tensor/manipulation.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as _dt
+from ..core.tensor import Tensor, apply, _to_data
+
+
+def _ints(v):
+    if isinstance(v, Tensor):
+        return tuple(int(x) for x in np.atleast_1d(np.asarray(v._data)))
+    if isinstance(v, (int, np.integer)):
+        return (int(v),)
+    return tuple(int(x._data) if isinstance(x, Tensor) else int(x) for x in v)
+
+
+def reshape(x, shape, name=None):
+    s = _ints(shape)
+    return apply("reshape", lambda a: jnp.reshape(a, s), x)
+
+
+def reshape_(x, shape, name=None):
+    return x._inplace_from(reshape(x, shape))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(a):
+        nd = a.ndim
+        st = start_axis % nd if nd else 0
+        sp = stop_axis % nd if nd else 0
+        new_shape = a.shape[:st] + (-1,) + a.shape[sp + 1:]
+        return jnp.reshape(a, new_shape)
+    return apply("flatten", f, x)
+
+
+def squeeze(x, axis=None, name=None):
+    def f(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = _ints(axis)
+        axes = tuple(ax % a.ndim for ax in axes if a.shape[ax % a.ndim] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+    return apply("squeeze", f, x)
+
+
+def squeeze_(x, axis=None, name=None):
+    return x._inplace_from(squeeze(x, axis))
+
+
+def unsqueeze(x, axis, name=None):
+    axes = _ints(axis)
+    return apply("unsqueeze", lambda a: jnp.expand_dims(a, axes), x)
+
+
+def unsqueeze_(x, axis, name=None):
+    return x._inplace_from(unsqueeze(x, axis))
+
+
+def transpose(x, perm, name=None):
+    p = _ints(perm)
+    return apply("transpose", lambda a: jnp.transpose(a, p), x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply("moveaxis", lambda a: jnp.moveaxis(a, _ints(source), _ints(destination)), x)
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return apply("swapaxes", lambda a: jnp.swapaxes(a, int(axis1), int(axis2)), x)
+
+
+transpose_ = None
+concat_list = None
+
+
+def concat(x, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    tensors = list(x)
+    return apply("concat", lambda *arrs: jnp.concatenate(arrs, axis=ax), *tensors)
+
+
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+    return apply("stack", lambda *arrs: jnp.stack(arrs, axis=axis), *tensors)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num if num is not None else x.shape[axis]
+    outs = apply("unstack", lambda a: tuple(jnp.moveaxis(a, axis, 0)[i] for i in range(n)), x)
+    return list(outs)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+
+    def f(a):
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(a, num_or_sections, axis=ax))
+        secs = [int(s._data) if isinstance(s, Tensor) else int(s) for s in num_or_sections]
+        total = a.shape[ax]
+        known = builtins_sum(s for s in secs if s >= 0)
+        secs = [s if s >= 0 else total - known for s in secs]
+        idx = np.cumsum(secs)[:-1].tolist()
+        return tuple(jnp.split(a, idx, axis=ax))
+    outs = apply("split", f, x)
+    return list(outs)
+
+
+import builtins
+builtins_sum = builtins.sum
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    def f(a):
+        return tuple(jnp.array_split(a, num_or_indices, axis=axis)) if isinstance(num_or_indices, int) \
+            else tuple(jnp.split(a, list(num_or_indices), axis=axis))
+    return list(apply("tensor_split", f, x))
+
+
+def tile(x, repeat_times, name=None):
+    reps = _ints(repeat_times)
+    return apply("tile", lambda a: jnp.tile(a, reps), x)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = repeats if isinstance(repeats, int) else _to_data(repeats)
+    return apply("repeat_interleave", lambda a: jnp.repeat(a, r, axis=axis), x)
+
+
+def expand(x, shape, name=None):
+    s = _ints(shape)
+
+    def f(a):
+        tgt = list(s)
+        src = list(a.shape)
+        src = [1] * (len(tgt) - len(src)) + src
+        tgt = [src[i] if tgt[i] == -1 else tgt[i] for i in range(len(tgt))]
+        return jnp.broadcast_to(a.reshape(src), tgt)
+    return apply("expand", f, x)
+
+
+def expand_as(x, y, name=None):
+    return apply("expand_as", lambda a, b: jnp.broadcast_to(a, b.shape), x, y)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    outs = apply("broadcast_tensors", lambda *arrs: tuple(jnp.broadcast_arrays(*arrs)), *inputs)
+    return list(outs)
+
+
+def flip(x, axis, name=None):
+    axes = _ints(axis)
+    return apply("flip", lambda a: jnp.flip(a, axis=axes), x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = _ints(shifts) if not isinstance(shifts, int) else shifts
+    ax = _ints(axis) if axis is not None and not isinstance(axis, int) else axis
+    return apply("roll", lambda a: jnp.roll(a, sh, axis=ax), x)
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply("gather", lambda a, idx: jnp.take(a, idx.astype(jnp.int32), axis=ax), x, index)
+
+
+def gather_nd(x, index, name=None):
+    def f(a, idx):
+        ii = tuple(jnp.moveaxis(idx.astype(jnp.int32), -1, 0))
+        return a[ii]
+    return apply("gather_nd", f, x, index)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply("take_along_axis",
+                 lambda a, idx: jnp.take_along_axis(a, idx.astype(jnp.int64), axis=axis),
+                 arr, indices)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True,
+                   broadcast=True, name=None):
+    def f(a, idx, v):
+        idx = idx.astype(jnp.int64)
+        v = jnp.broadcast_to(v, idx.shape).astype(a.dtype)
+        dims = list(range(a.ndim))
+        grids = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij")
+        full_idx = [grids[d] for d in dims]
+        full_idx[axis] = idx
+        if reduce == "assign":
+            return a.at[tuple(full_idx)].set(v)
+        if reduce == "add":
+            return a.at[tuple(full_idx)].add(v)
+        if reduce in ("mul", "multiply"):
+            return a.at[tuple(full_idx)].multiply(v)
+        if reduce == "amax":
+            return a.at[tuple(full_idx)].max(v)
+        if reduce == "amin":
+            return a.at[tuple(full_idx)].min(v)
+        raise ValueError(f"unknown reduce {reduce}")
+    return apply("put_along_axis", f, arr, indices, values)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(a, idx, upd):
+        idx = idx.astype(jnp.int32).reshape(-1)
+        if overwrite:
+            return a.at[idx].set(upd.astype(a.dtype))
+        # paddle semantics: zero the rows then accumulate
+        zeroed = a.at[idx].set(jnp.zeros_like(upd, a.dtype))
+        return zeroed.at[idx].add(upd.astype(a.dtype))
+    return apply("scatter", f, x, index, updates)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return x._inplace_from(scatter(x, index, updates, overwrite))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(a, idx, upd):
+        ii = tuple(jnp.moveaxis(idx.astype(jnp.int32), -1, 0))
+        return a.at[ii].add(upd.astype(a.dtype))
+    return apply("scatter_nd_add", f, x, index, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    s = _ints(shape)
+
+    def f(idx, upd):
+        out = jnp.zeros(s, upd.dtype)
+        ii = tuple(jnp.moveaxis(idx.astype(jnp.int32), -1, 0))
+        return out.at[ii].add(upd)
+    return apply("scatter_nd", f, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply("index_select", lambda a, idx: jnp.take(a, idx.astype(jnp.int32), axis=axis), x, index)
+
+
+def index_sample(x, index, name=None):
+    return apply("index_sample",
+                 lambda a, idx: jnp.take_along_axis(a, idx.astype(jnp.int64), axis=1), x, index)
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(a, idx, v):
+        a2 = jnp.moveaxis(a, axis, 0)
+        v2 = jnp.moveaxis(v, axis, 0)
+        out = a2.at[idx.astype(jnp.int32)].add(v2.astype(a.dtype))
+        return jnp.moveaxis(out, 0, axis)
+    return apply("index_add", f, x, index, value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def f(a, v, *idx):
+        ii = tuple(i.astype(jnp.int64) if jnp.issubdtype(i.dtype, jnp.integer) else i for i in idx)
+        if accumulate:
+            return a.at[ii].add(v.astype(a.dtype))
+        return a.at[ii].set(jnp.broadcast_to(v, a[ii].shape).astype(a.dtype))
+    return apply("index_put", f, x, value, *indices)
+
+
+def masked_select(x, mask, name=None):
+    # dynamic shape: eager-only (not jittable) — reference has the same property on GPU
+    data = _to_data(x)
+    m = _to_data(mask)
+    return Tensor(data[m])
+
+
+def masked_fill(x, mask, value, name=None):
+    v = value.item() if isinstance(value, Tensor) and value.size == 1 else value
+    def f(a, m):
+        return jnp.where(m, jnp.asarray(v, a.dtype), a)
+    return apply("masked_fill", f, x, mask)
+
+
+def masked_fill_(x, mask, value, name=None):
+    return x._inplace_from(masked_fill(x, mask, value))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply("where", lambda c, a, b: jnp.where(c, a, b), condition, x, y)
+
+
+def where_(condition, x, y, name=None):
+    return x._inplace_from(where(condition, x, y))
+
+
+def nonzero(x, as_tuple=False):
+    data = np.asarray(_to_data(x))  # dynamic shape -> host
+    nz = np.nonzero(data)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.reshape(-1, 1))) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    p = _ints(pad)
+
+    def f(a):
+        nd = a.ndim
+        if len(p) == 2 * nd:
+            width = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle NCHW convention: pad applies to last len(p)//2 spatial dims, reversed
+            width = [(0, 0)] * nd
+            np_ = len(p) // 2
+            if data_format.endswith("HWC") or data_format in ("NLC", "NHWC", "NDHWC"):
+                dims = list(range(1, 1 + np_))
+            else:
+                dims = list(range(nd - np_, nd))
+            for i, d in enumerate(dims):
+                width[d] = (p[2 * i], p[2 * i + 1])
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+                 "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, width, mode="constant", constant_values=value)
+        return jnp.pad(a, width, mode=jmode)
+    return apply("pad", f, x)
+
+
+def cast(x, dtype):
+    npd = _dt.to_np(dtype)
+    return apply("cast", lambda a: a.astype(npd), x)
+
+
+def slice(input, axes, starts, ends):
+    ax = _ints(axes)
+    st = _ints(starts)
+    en = _ints(ends)
+
+    def f(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for i, axis in enumerate(ax):
+            idx[axis] = builtins.slice(st[i], en[i])
+        return a[tuple(idx)]
+    return apply("slice", f, input)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    ax, st, en, sr = _ints(axes), _ints(starts), _ints(ends), _ints(strides)
+
+    def f(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for i, axis in enumerate(ax):
+            idx[axis] = builtins.slice(st[i], en[i], sr[i])
+        return a[tuple(idx)]
+    return apply("strided_slice", f, x)
+
+
+def unbind(input, axis=0):
+    n = input.shape[axis]
+    outs = apply("unbind", lambda a: tuple(jnp.moveaxis(a, axis, 0)[i] for i in range(n)), input)
+    return list(outs)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None,
+           dtype="int64", name=None):
+    data = np.asarray(_to_data(x))  # dynamic shape -> host
+    res = np.unique(data, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    data = np.asarray(_to_data(x))
+    flat = data.reshape(-1) if axis is None else data
+    if axis is None:
+        keep = np.concatenate([[True], flat[1:] != flat[:-1]])
+        out = flat[keep]
+        outs = [Tensor(jnp.asarray(out))]
+        if return_inverse:
+            inv = np.cumsum(keep) - 1
+            outs.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+        if return_counts:
+            idx = np.nonzero(keep)[0]
+            cnt = np.diff(np.append(idx, flat.size))
+            outs.append(Tensor(jnp.asarray(cnt.astype(np.int64))))
+        return outs[0] if len(outs) == 1 else tuple(outs)
+    raise NotImplementedError("unique_consecutive with axis")
+
+
+def as_complex(x, name=None):
+    return apply("as_complex", lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x)
+
+
+def as_real(x, name=None):
+    return apply("as_real", lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), x)
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return x.astype(shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    def f(a):
+        flat = a.reshape(-1)
+        idx = offset + builtins.sum(
+            (jnp.arange(s).reshape([-1 if i == d else 1 for i in range(len(shape))]) * st
+             for d, (s, st) in enumerate(zip(shape, stride))))
+        return flat[idx]
+    return apply("as_strided", f, x)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply("atleast_1d", jnp.atleast_1d, x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply("atleast_2d", jnp.atleast_2d, x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply("atleast_3d", jnp.atleast_3d, x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def f(a):
+        size = (index_num + nshards - 1) // nshards
+        lo = shard_id * size
+        inshard = (a >= lo) & (a < lo + size)
+        return jnp.where(inshard, a - lo, ignore_value)
+    return apply("shard_index", f, input)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    s = _ints(shape)
+    off = _ints(offsets) if offsets is not None else (0,) * len(s)
+
+    def f(a):
+        idx = tuple(builtins.slice(off[i], off[i] + (s[i] if s[i] != -1 else a.shape[i] - off[i]))
+                    for i in range(a.ndim))
+        return a[idx]
+    return apply("crop", f, x)
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size if isinstance(x, Tensor) else _to_data(x).size, jnp.int64))
+
+
+def rank(input):
+    return Tensor(jnp.asarray(_to_data(input).ndim, jnp.int32))
+
+
+def shape(input):
+    return Tensor(jnp.asarray(_to_data(input).shape, jnp.int32))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(_to_data(x).size == 0))
+
+
+def is_complex(x):
+    return jnp.issubdtype(_to_data(x).dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(_to_data(x).dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(_to_data(x).dtype, jnp.integer)
+
+
+def rad2deg_(x):
+    return x._inplace_from(apply("rad2deg", jnp.rad2deg, x))

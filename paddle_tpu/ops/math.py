@@ -1,0 +1,386 @@
+"""Math ops (reference: `python/paddle/tensor/math.py`, `ops.py`).
+
+Every function dispatches through `core.tensor.apply`, so it records on the autograd tape
+and autocasts under AMP exactly like a generated ad_func in the reference.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as _dt
+from ..core.tensor import Tensor, apply, _to_data
+
+
+def _unary(name, jfn):
+    def op(x, name=None):
+        return apply(name_, jfn, x)
+    name_ = name
+    op.__name__ = name
+    return op
+
+
+def _binary(name, jfn):
+    def op(x, y, name=None):
+        return apply(name_, jfn, x, y)
+    name_ = name
+    op.__name__ = name
+    return op
+
+
+# ---- unary elementwise ----
+abs = _unary("abs", jnp.abs)
+acos = _unary("acos", jnp.arccos)
+acosh = _unary("acosh", jnp.arccosh)
+asin = _unary("asin", jnp.arcsin)
+asinh = _unary("asinh", jnp.arcsinh)
+atan = _unary("atan", jnp.arctan)
+atanh = _unary("atanh", jnp.arctanh)
+ceil = _unary("ceil", jnp.ceil)
+conj = _unary("conj", jnp.conj)
+cos = _unary("cos", jnp.cos)
+cosh = _unary("cosh", jnp.cosh)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+floor = _unary("floor", jnp.floor)
+frac = _unary("frac", lambda x: x - jnp.trunc(x))
+i0 = _unary("i0", jax.scipy.special.i0)
+i0e = _unary("i0e", jax.scipy.special.i0e)
+i1 = _unary("i1", jax.scipy.special.i1)
+i1e = _unary("i1e", jax.scipy.special.i1e)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+log = _unary("log", jnp.log)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+log2 = _unary("log2", jnp.log2)
+neg = _unary("neg", jnp.negative)
+reciprocal = _unary("reciprocal", jnp.reciprocal)
+round = _unary("round", jnp.round)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+sgn = _unary("sgn", jnp.sign)
+sign = _unary("sign", jnp.sign)
+sin = _unary("sin", jnp.sin)
+sinh = _unary("sinh", jnp.sinh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+tan = _unary("tan", jnp.tan)
+tanh = _unary("tanh", jnp.tanh)
+trunc = _unary("trunc", jnp.trunc)
+angle = _unary("angle", jnp.angle)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+isfinite = _unary("isfinite", jnp.isfinite)
+isinf = _unary("isinf", jnp.isinf)
+isnan = _unary("isnan", jnp.isnan)
+isneginf = _unary("isneginf", jnp.isneginf)
+isposinf = _unary("isposinf", jnp.isposinf)
+isreal = _unary("isreal", jnp.isreal)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+exponential_ = None  # random module provides
+
+# ---- binary elementwise ----
+add = _binary("add", jnp.add)
+subtract = _binary("subtract", jnp.subtract)
+multiply = _binary("multiply", jnp.multiply)
+divide = _binary("divide", jnp.true_divide)
+floor_divide = _binary("floor_divide", jnp.floor_divide)
+mod = _binary("remainder", jnp.remainder)
+remainder = mod
+floor_mod = mod
+pow = _binary("pow", jnp.power)
+maximum = _binary("maximum", jnp.maximum)
+minimum = _binary("minimum", jnp.minimum)
+fmax = _binary("fmax", jnp.fmax)
+fmin = _binary("fmin", jnp.fmin)
+atan2 = _binary("atan2", jnp.arctan2)
+logaddexp = _binary("logaddexp", jnp.logaddexp)
+hypot = _binary("hypot", lambda x, y: jnp.sqrt(x * x + y * y))
+copysign = _binary("copysign", jnp.copysign)
+nextafter = _binary("nextafter", jnp.nextafter)
+ldexp = _binary("ldexp", lambda x, y: x * jnp.power(2.0, y).astype(x.dtype) if jnp.issubdtype(x.dtype, jnp.floating) else (x * (2 ** y)))
+gammaln = lgamma
+polygamma = lambda x, n, name=None: apply("polygamma", lambda a: jax.scipy.special.polygamma(n, a), x)
+heaviside = _binary("heaviside", lambda x, y: jnp.where(x < 0, 0.0, jnp.where(x > 0, 1.0, y)).astype(x.dtype))
+inner = _binary("inner", jnp.inner)
+outer = _binary("outer", lambda x, y: jnp.outer(x, y))
+kron = _binary("kron", jnp.kron)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = scale.item() if isinstance(scale, Tensor) else scale
+    if bias_after_scale:
+        out = apply("scale", lambda a: a * s + bias, x)
+    else:
+        out = apply("scale", lambda a: (a + bias) * s, x)
+    return out
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return apply("clip", lambda a: jnp.clip(a, lo, hi), x)
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, (int, float)):
+        return apply("lerp", lambda a, b: a + weight * (b - a), x, y)
+    return apply("lerp", lambda a, b, w: a + w * (b - a), x, y, weight)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), x)
+
+
+def multiplex(inputs, index, name=None):
+    def f(idx, *ins):
+        stacked = jnp.stack(ins, axis=0)
+        return jnp.take_along_axis(
+            stacked, idx.reshape((1, -1) + (1,) * (stacked.ndim - 2)).astype(jnp.int32),
+            axis=0)[0]
+    return apply("multiplex", f, index, *inputs)
+
+
+# ---- matmul family ----
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply("matmul", f, x, y)
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return apply("bmm", jnp.matmul, x, y)
+
+
+def dot(x, y, name=None):
+    return apply("dot", lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+
+
+def mv(x, vec, name=None):
+    return apply("mv", jnp.matmul, x, vec)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply("addmm", lambda i, a, b: beta * i + alpha * jnp.matmul(a, b), input, x, y)
+
+
+def t(x, name=None):
+    return apply("t", lambda a: a.T if a.ndim == 2 else a, x)
+
+
+# ---- reductions ----
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        ax = np.asarray(axis._data)
+        return tuple(int(a) for a in np.atleast_1d(ax))
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    npd = _dt.to_np(dtype) if dtype is not None else None
+
+    def f(a):
+        out_dtype = npd
+        if out_dtype is None and jnp.issubdtype(a.dtype, jnp.bool_):
+            out_dtype = jnp.int64
+        return jnp.sum(a, axis=ax, keepdims=keepdim, dtype=out_dtype)
+    return apply("sum", f, x)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply("mean", lambda a: jnp.mean(a, axis=ax, keepdims=keepdim), x)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    ax = _axis(axis)
+    npd = _dt.to_np(dtype) if dtype is not None else None
+    return apply("prod", lambda a: jnp.prod(a, axis=ax, keepdims=keepdim, dtype=npd), x)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply("max", lambda a: jnp.max(a, axis=ax, keepdims=keepdim), x)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply("min", lambda a: jnp.min(a, axis=ax, keepdims=keepdim), x)
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply("logsumexp", lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim), x)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply("all", lambda a: jnp.all(a, axis=ax, keepdims=keepdim), x)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply("any", lambda a: jnp.any(a, axis=ax, keepdims=keepdim), x)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply("count_nonzero", lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim), x)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    npd = _dt.to_np(dtype) if dtype is not None else None
+    return apply("nansum", lambda a: jnp.nansum(a, axis=ax, keepdims=keepdim, dtype=npd), x)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply("nanmean", lambda a: jnp.nanmean(a, axis=ax, keepdims=keepdim), x)
+
+
+# ---- cumulative ----
+def cumsum(x, axis=None, dtype=None, name=None):
+    npd = _dt.to_np(dtype) if dtype is not None else None
+
+    def f(a):
+        if axis is None:
+            return jnp.cumsum(a.reshape(-1), dtype=npd)
+        return jnp.cumsum(a, axis=int(axis), dtype=npd)
+    return apply("cumsum", f, x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    npd = _dt.to_np(dtype) if dtype is not None else None
+    return apply("cumprod", lambda a: jnp.cumprod(a, axis=dim, dtype=npd), x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def f(a):
+        ax = axis if axis is not None else 0
+        arr = a.reshape(-1) if axis is None else a
+        vals = jax.lax.associative_scan(jnp.maximum, arr, axis=ax if axis is not None else 0)
+        n = arr.shape[ax if axis is not None else 0]
+        eq = arr == vals
+        idx = jnp.arange(n).reshape([-1 if i == (ax % arr.ndim) else 1 for i in range(arr.ndim)])
+        inds = jax.lax.associative_scan(jnp.maximum, jnp.where(eq, idx, -1), axis=ax)
+        return vals, inds.astype(_dt.to_np(dtype))
+    return apply("cummax", f, x)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def f(a):
+        ax = axis if axis is not None else 0
+        arr = a.reshape(-1) if axis is None else a
+        vals = jax.lax.associative_scan(jnp.minimum, arr, axis=ax)
+        n = arr.shape[ax]
+        eq = arr == vals
+        idx = jnp.arange(n).reshape([-1 if i == (ax % arr.ndim) else 1 for i in range(arr.ndim)])
+        inds = jax.lax.associative_scan(jnp.maximum, jnp.where(eq, idx, -1), axis=ax)
+        return vals, inds.astype(_dt.to_np(dtype))
+    return apply("cummin", f, x)
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def f(a):
+        arr = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else axis
+        return jax.lax.associative_scan(jnp.logaddexp, arr, axis=ax)
+    return apply("logcumsumexp", f, x)
+
+
+# ---- misc ----
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply("trace", lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply("diagonal", lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = _to_data(prepend) if prepend is not None else None
+    app = _to_data(append) if append is not None else None
+    return apply("diff", lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre, append=app), x)
+
+
+def cross(x, y, axis=9, name=None):
+    ax = axis if axis != 9 else None
+    def f(a, b):
+        use_ax = ax
+        if use_ax is None:
+            for i, s in enumerate(a.shape):
+                if s == 3:
+                    use_ax = i
+                    break
+        return jnp.cross(a, b, axis=use_ax)
+    return apply("cross", f, x, y)
+
+
+def gcd(x, y, name=None):
+    return apply("gcd", jnp.gcd, x, y)
+
+
+def lcm(x, y, name=None):
+    return apply("lcm", jnp.lcm, x, y)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply("nan_to_num", lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), x)
+
+
+def take(x, index, mode="raise", name=None):
+    def f(a, idx):
+        flat = a.reshape(-1)
+        n = flat.shape[0]
+        ii = idx.astype(jnp.int64)
+        if mode == "wrap":
+            ii = jnp.mod(ii, n)
+        else:
+            ii = jnp.clip(jnp.where(ii < 0, ii + n, ii), 0, n - 1)
+        return flat[ii]
+    return apply("take", f, x, index)
+
+
+def increment(x, value=1.0, name=None):
+    x._data = x._data + value
+    return x
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    def f(pred, lab):
+        topk = jnp.argsort(-pred, axis=-1)[:, :k]
+        match = jnp.any(topk == lab.reshape(-1, 1), axis=-1)
+        return jnp.mean(match.astype(jnp.float32))
+    return apply("accuracy", f, input, label)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))

@@ -1,0 +1,180 @@
+"""Random ops (reference: `python/paddle/tensor/random.py`).
+
+Statefulness: eager random ops draw from the default `Generator` (core/generator.py),
+which splits a fresh jax PRNG subkey per call — matching the reference's global-seeded
+Philox behaviour.  Inside `to_static`/jit the RNG key is captured as explicit state.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as _dt
+from ..core import generator as _gen
+from ..core.tensor import Tensor, apply, _to_data
+from .creation import _shape
+
+
+def _npd(dtype, default=_dt.float32):
+    return _dt.to_np(dtype) if dtype is not None else _dt.to_np(_dt._default_dtype if default is None else default)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else _gen.next_key()
+    d = _npd(dtype, None)
+    return Tensor(jax.random.uniform(key, _shape(shape), d, minval=min, maxval=max))
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    return standard_normal(shape, dtype)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(_gen.next_key(), _shape(shape), _npd(dtype, None)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = _to_data(mean)
+        s = _to_data(std)
+        out_shape = np.broadcast_shapes(np.shape(m), np.shape(s))
+        z = jax.random.normal(_gen.next_key(), out_shape, jnp.float32)
+        return Tensor(m + s * z)
+    z = jax.random.normal(_gen.next_key(), _shape(shape or [1]), _npd(None, None))
+    return Tensor(mean + std * z)
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    key = jax.random.key(seed) if seed else _gen.next_key()
+    z = jax.random.normal(key, _shape(shape), _npd(dtype, None))
+    return Tensor(mean + std * z)
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    d = _dt.to_np(dtype) if dtype is not None else np.int64
+    return Tensor(jax.random.randint(_gen.next_key(), _shape(shape), low, high, d))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    data = _to_data(x)
+    if high is None:
+        low, high = 0, low
+    d = _dt.to_np(dtype) if dtype is not None else data.dtype
+    out = jax.random.randint(_gen.next_key(), data.shape, low, high,
+                             d if np.issubdtype(d, np.integer) else np.int64)
+    return Tensor(out.astype(d))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(_gen.next_key(), int(n)).astype(_dt.to_np(dtype)))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    data = _to_data(x)
+    key = _gen.next_key()
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1,
+                                     shape=(num_samples,) + data.shape[:-1])
+        out = jnp.moveaxis(out, 0, -1)
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(key, data.shape)
+        out = jnp.argsort(-(logits + g), axis=-1)[..., :num_samples]
+    return Tensor(out.astype(jnp.int64))
+
+
+def bernoulli(x, name=None):
+    data = _to_data(x)
+    u = jax.random.uniform(_gen.next_key(), data.shape, data.dtype)
+    return Tensor((u < data).astype(data.dtype))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    u = jax.random.uniform(_gen.next_key(), x._data.shape)
+    x._data = (u < p).astype(x._data.dtype)
+    return x
+
+
+def poisson(x, name=None):
+    data = _to_data(x)
+    return Tensor(jax.random.poisson(_gen.next_key(), data, data.shape).astype(data.dtype))
+
+
+def binomial(count, prob, name=None):
+    c = _to_data(count)
+    p = _to_data(prob)
+    return Tensor(jax.random.binomial(_gen.next_key(), c.astype(jnp.float32),
+                                      p.astype(jnp.float32)).astype(jnp.int64))
+
+
+def exponential_(x, lam=1.0, name=None):
+    u = jax.random.uniform(_gen.next_key(), x._data.shape, x._data.dtype if
+                           jnp.issubdtype(x._data.dtype, jnp.floating) else jnp.float32)
+    x._data = (-jnp.log1p(-u) / lam).astype(x._data.dtype)
+    return x
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    key = _gen.next_key()
+    x._data = (loc + scale * jax.random.cauchy(key, x._data.shape)).astype(x._data.dtype)
+    return x
+
+
+def geometric_(x, probs, name=None):
+    u = jax.random.uniform(_gen.next_key(), x._data.shape)
+    x._data = (jnp.ceil(jnp.log(u) / jnp.log1p(-probs))).astype(x._data.dtype)
+    return x
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    z = jax.random.normal(_gen.next_key(), x._data.shape)
+    x._data = jnp.exp(mean + std * z).astype(x._data.dtype)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    z = jax.random.normal(_gen.next_key(), x._data.shape)
+    x._data = (mean + std * z).astype(x._data.dtype)
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else _gen.next_key()
+    x._data = jax.random.uniform(key, x._data.shape, x._data.dtype, min, max)
+    return x
+
+
+def rand_like(x, dtype=None, name=None):
+    data = _to_data(x)
+    d = _dt.to_np(dtype) if dtype is not None else data.dtype
+    return Tensor(jax.random.uniform(_gen.next_key(), data.shape, d))
+
+
+def randn_like(x, dtype=None, name=None):
+    data = _to_data(x)
+    d = _dt.to_np(dtype) if dtype is not None else data.dtype
+    return Tensor(jax.random.normal(_gen.next_key(), data.shape, d))
+
+
+def get_rng_state():
+    return [_gen.default_generator().get_state()]
+
+
+def set_rng_state(state):
+    _gen.default_generator().set_state(state[0] if isinstance(state, (list, tuple)) else state)
+
+
+def get_cuda_rng_state():
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    set_rng_state(state)

@@ -1,0 +1,111 @@
+"""Search/sort ops (reference: `python/paddle/tensor/search.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.lax as lax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as _dt
+from ..core.tensor import Tensor, apply, _to_data
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(a):
+        out = jnp.argmax(a if axis is not None else a.reshape(-1), axis=axis)
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, axis)
+        return out.astype(_dt.to_np(dtype))
+    return apply("argmax", f, x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(a):
+        out = jnp.argmin(a if axis is not None else a.reshape(-1), axis=axis)
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, axis)
+        return out.astype(_dt.to_np(dtype))
+    return apply("argmin", f, x)
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        out = jnp.argsort(-a if descending else a, axis=axis, stable=stable or descending)
+        return out.astype(jnp.int64)
+    return apply("argsort", f, x)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        out = jnp.sort(a, axis=axis)
+        return jnp.flip(out, axis=axis) if descending else out
+    return apply("sort", f, x)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    kk = int(k.item()) if isinstance(k, Tensor) else int(k)
+
+    def f(a):
+        ax = axis if axis is not None else a.ndim - 1
+        moved = jnp.moveaxis(a, ax, -1)
+        vals, idx = _topk_impl(moved, kk, largest)
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax).astype(jnp.int64)
+    return apply("topk", f, x)
+
+
+def _topk_impl(a, k, largest):
+    if largest:
+        return lax.top_k(a, k)
+    vals, idx = lax.top_k(-a, k)
+    return -vals, idx
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(a):
+        srt = jnp.sort(a, axis=axis)
+        ids = jnp.argsort(a, axis=axis)
+        vals = jnp.take(srt, k - 1, axis=axis)
+        inds = jnp.take(ids, k - 1, axis=axis).astype(jnp.int64)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            inds = jnp.expand_dims(inds, axis)
+        return vals, inds
+    return apply("kthvalue", f, x)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def f(a):
+        srt = jnp.sort(a, axis=axis)
+        n = a.shape[axis]
+        moved = jnp.moveaxis(srt, axis, -1)
+        runs = jnp.concatenate([jnp.ones(moved.shape[:-1] + (1,), bool),
+                                moved[..., 1:] != moved[..., :-1]], axis=-1)
+        run_id = jnp.cumsum(runs, axis=-1)
+        counts = jnp.sum(run_id[..., :, None] == run_id[..., None, :], axis=-1)
+        best = jnp.argmax(counts, axis=-1)
+        vals = jnp.take_along_axis(moved, best[..., None], axis=-1)[..., 0]
+        idx = jnp.argmax(jnp.moveaxis(a, axis, -1) == vals[..., None], axis=-1)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx.astype(jnp.int64)
+    return apply("mode", f, x)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def f(seq, v):
+        side = "right" if right else "left"
+        if seq.ndim == 1:
+            out = jnp.searchsorted(seq, v, side=side)
+        else:
+            out = jnp.stack([jnp.searchsorted(seq[i], v[i], side=side)
+                             for i in range(seq.shape[0])])
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+    return apply("searchsorted", f, sorted_sequence, values)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    def f(v, seq):
+        out = jnp.searchsorted(seq, v, side="right" if right else "left")
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+    return apply("bucketize", f, x, sorted_sequence)

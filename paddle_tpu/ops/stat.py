@@ -1,0 +1,69 @@
+"""Statistics ops (reference: `python/paddle/tensor/stat.py`)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply, _to_data
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply("mean", lambda a: jnp.mean(a, axis=ax, keepdims=keepdim), x)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply("var", lambda a: jnp.var(a, axis=ax, ddof=1 if unbiased else 0,
+                                          keepdims=keepdim), x)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply("std", lambda a: jnp.std(a, axis=ax, ddof=1 if unbiased else 0,
+                                          keepdims=keepdim), x)
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = _axis(axis)
+
+    def f(a):
+        if mode == "avg":
+            return jnp.median(a, axis=ax, keepdims=keepdim)
+        # min mode: lower of the two middles
+        arr = a.reshape(-1) if ax is None else a
+        use_ax = 0 if ax is None else ax
+        srt = jnp.sort(arr, axis=use_ax)
+        n = srt.shape[use_ax]
+        out = jnp.take(srt, (n - 1) // 2, axis=use_ax)
+        if keepdim and ax is not None:
+            out = jnp.expand_dims(out, ax)
+        return out
+    return apply("median", f, x)
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = _axis(axis)
+    return apply("nanmedian", lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = _axis(axis)
+    qq = _to_data(q) if isinstance(q, Tensor) else jnp.asarray(q)
+    return apply("quantile", lambda a: jnp.quantile(a.astype(jnp.float32), qq, axis=ax,
+                                                    keepdims=keepdim, method=interpolation), x)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = _axis(axis)
+    qq = _to_data(q) if isinstance(q, Tensor) else jnp.asarray(q)
+    return apply("nanquantile", lambda a: jnp.nanquantile(a.astype(jnp.float32), qq, axis=ax,
+                                                          keepdims=keepdim, method=interpolation), x)

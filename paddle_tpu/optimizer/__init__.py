@@ -1,0 +1,354 @@
+"""Optimizers (reference: `python/paddle/optimizer/` — SGD, Momentum, Adam, AdamW,
+Adamax, Adagrad, Adadelta, RMSProp, Lamb, LBFGS; fused `_C_ops.adam_` parity is one
+jnp-fused update per parameter)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from . import lr  # noqa
+from .optimizer import Optimizer
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _append_optimize_op(self, p, g):
+        lr = self._lr_for(p)
+        p._data = (p._data.astype(jnp.float32) - lr * g._data.astype(jnp.float32)) \
+            .astype(p._data.dtype)
+
+    def _functional_update(self, param, grad, state, lr):
+        return param - lr * grad, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _acc_names(self):
+        return ["velocity"]
+
+    def _append_optimize_op(self, p, g):
+        lr = self._lr_for(p)
+        v = self._acc("velocity", p)
+        g32 = g._data.astype(jnp.float32)
+        v = self._momentum * v + g32
+        if self._use_nesterov:
+            upd = g32 + self._momentum * v
+        else:
+            upd = v
+        self._set_acc("velocity", p, v)
+        p._data = (p._data.astype(jnp.float32) - lr * upd).astype(p._data.dtype)
+
+    def _init_functional_state(self, param):
+        return {"velocity": jnp.zeros_like(param, dtype=jnp.float32)}
+
+    def _functional_update(self, param, grad, state, lr):
+        v = self._momentum * state["velocity"] + grad
+        upd = grad + self._momentum * v if self._use_nesterov else v
+        return param - lr * upd, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, use_multi_tensor=False, name=None,
+                 amsgrad=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._multi_precision = multi_precision
+
+    def _acc_names(self):
+        return ["moment1", "moment2", "beta1_pow", "beta2_pow"]
+
+    def _beta(self, b):
+        return float(b.item()) if isinstance(b, Tensor) else float(b)
+
+    def _append_optimize_op(self, p, g):
+        lr = self._lr_for(p)
+        b1 = self._beta(self._beta1)
+        b2 = self._beta(self._beta2)
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        b1p = self._acc("beta1_pow", p, jnp.asarray(1.0, jnp.float32))
+        b2p = self._acc("beta2_pow", p, jnp.asarray(1.0, jnp.float32))
+        g32 = g._data.astype(jnp.float32)
+        m = b1 * m + (1 - b1) * g32
+        v = b2 * v + (1 - b2) * g32 * g32
+        b1p = b1p * b1
+        b2p = b2p * b2
+        mhat = m / (1 - b1p)
+        vhat = v / (1 - b2p)
+        upd = mhat / (jnp.sqrt(vhat) + self._epsilon)
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        self._set_acc("beta1_pow", p, b1p)
+        self._set_acc("beta2_pow", p, b2p)
+        p._data = (p._data.astype(jnp.float32) - lr * upd).astype(p._data.dtype)
+
+    def _init_functional_state(self, param):
+        return {"m": jnp.zeros_like(param, dtype=jnp.float32),
+                "v": jnp.zeros_like(param, dtype=jnp.float32),
+                "b1p": jnp.ones((), jnp.float32),
+                "b2p": jnp.ones((), jnp.float32)}
+
+    def _functional_update(self, param, grad, state, lr):
+        b1 = self._beta(self._beta1)
+        b2 = self._beta(self._beta2)
+        g32 = grad.astype(jnp.float32)
+        m = b1 * state["m"] + (1 - b1) * g32
+        v = b2 * state["v"] + (1 - b2) * g32 * g32
+        b1p = state["b1p"] * b1
+        b2p = state["b2p"] * b2
+        upd = (m / (1 - b1p)) / (jnp.sqrt(v / (1 - b2p)) + self._epsilon)
+        new_p = (param.astype(jnp.float32) - lr * upd).astype(param.dtype)
+        return new_p, {"m": m, "v": v, "b1p": b1p, "b2p": b2p}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference `optimizer/adamw.py`)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None, amsgrad=False):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None,
+                         grad_clip, lazy_mode, multi_precision, name=name)
+        if isinstance(weight_decay, Tensor):
+            self._coeff = float(weight_decay.item())
+        else:
+            self._coeff = float(weight_decay)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _append_optimize_op(self, p, g):
+        lr = self._lr_for(p)
+        if self._lr_ratio is not None:
+            lr = lr * self._lr_ratio(p)
+        if self._apply_decay_param_fun is None or self._apply_decay_param_fun(p.name):
+            p._data = (p._data.astype(jnp.float32) * (1.0 - lr * self._coeff)) \
+                .astype(p._data.dtype)
+        super()._append_optimize_op(p, g)
+
+    def _functional_update(self, param, grad, state, lr):
+        decayed = param.astype(jnp.float32) * (1.0 - lr * self._coeff)
+        return super()._functional_update(decayed.astype(param.dtype), grad, state, lr)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _acc_names(self):
+        return ["moment", "inf_norm", "beta1_pow"]
+
+    def _append_optimize_op(self, p, g):
+        lr = self._lr_for(p)
+        m = self._acc("moment", p)
+        u = self._acc("inf_norm", p)
+        b1p = self._acc("beta1_pow", p, jnp.asarray(1.0, jnp.float32))
+        g32 = g._data.astype(jnp.float32)
+        m = self._beta1 * m + (1 - self._beta1) * g32
+        u = jnp.maximum(self._beta2 * u, jnp.abs(g32) + self._epsilon)
+        b1p = b1p * self._beta1
+        self._set_acc("moment", p, m)
+        self._set_acc("inf_norm", p, u)
+        self._set_acc("beta1_pow", p, b1p)
+        p._data = (p._data.astype(jnp.float32) - lr / (1 - b1p) * (m / u)) \
+            .astype(p._data.dtype)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_acc_value = initial_accumulator_value
+
+    def _acc_names(self):
+        return ["moment"]
+
+    def _append_optimize_op(self, p, g):
+        lr = self._lr_for(p)
+        acc = self._acc("moment", p, jnp.full(p._data.shape, self._init_acc_value,
+                                              jnp.float32))
+        g32 = g._data.astype(jnp.float32)
+        acc = acc + g32 * g32
+        self._set_acc("moment", p, acc)
+        p._data = (p._data.astype(jnp.float32)
+                   - lr * g32 / (jnp.sqrt(acc) + self._epsilon)).astype(p._data.dtype)
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _acc_names(self):
+        return ["avg_squared_grad", "avg_squared_update"]
+
+    def _append_optimize_op(self, p, g):
+        lr = self._lr_for(p)
+        Eg = self._acc("avg_squared_grad", p)
+        Ex = self._acc("avg_squared_update", p)
+        g32 = g._data.astype(jnp.float32)
+        Eg = self._rho * Eg + (1 - self._rho) * g32 * g32
+        upd = jnp.sqrt(Ex + self._epsilon) / jnp.sqrt(Eg + self._epsilon) * g32
+        Ex = self._rho * Ex + (1 - self._rho) * upd * upd
+        self._set_acc("avg_squared_grad", p, Eg)
+        self._set_acc("avg_squared_update", p, Ex)
+        p._data = (p._data.astype(jnp.float32) - lr * upd).astype(p._data.dtype)
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _acc_names(self):
+        return ["mean_square", "mean_grad", "momentum"]
+
+    def _append_optimize_op(self, p, g):
+        lr = self._lr_for(p)
+        ms = self._acc("mean_square", p)
+        mom = self._acc("momentum", p)
+        g32 = g._data.astype(jnp.float32)
+        ms = self._rho * ms + (1 - self._rho) * g32 * g32
+        if self._centered:
+            mg = self._acc("mean_grad", p)
+            mg = self._rho * mg + (1 - self._rho) * g32
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+            self._set_acc("mean_grad", p, mg)
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * mom + lr * g32 / denom
+        self._set_acc("mean_square", p, ms)
+        self._set_acc("momentum", p, mom)
+        p._data = (p._data.astype(jnp.float32) - mom).astype(p._data.dtype)
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-06, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _acc_names(self):
+        return ["moment1", "moment2", "beta1_pow", "beta2_pow"]
+
+    def _append_optimize_op(self, p, g):
+        lr = self._lr_for(p)
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        b1p = self._acc("beta1_pow", p, jnp.asarray(1.0, jnp.float32))
+        b2p = self._acc("beta2_pow", p, jnp.asarray(1.0, jnp.float32))
+        g32 = g._data.astype(jnp.float32)
+        m = self._beta1 * m + (1 - self._beta1) * g32
+        v = self._beta2 * v + (1 - self._beta2) * g32 * g32
+        b1p = b1p * self._beta1
+        b2p = b2p * self._beta2
+        mhat = m / (1 - b1p)
+        vhat = v / (1 - b2p)
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon)
+        wd = 0.0 if (self._exclude_fn is not None and self._exclude_fn(p)) else self._wd
+        p32 = p._data.astype(jnp.float32)
+        r = r + wd * p32
+        w_norm = jnp.linalg.norm(p32)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        self._set_acc("beta1_pow", p, b1p)
+        self._set_acc("beta2_pow", p, b2p)
+        p._data = (p32 - lr * trust * r).astype(p._data.dtype)
+
+
+class LBFGS(Optimizer):
+    """L-BFGS (reference `optimizer/lbfgs.py`): closure-based full-batch optimizer."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-07, tolerance_change=1e-09, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._max_iter = max_iter
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._history = history_size
+        self._s, self._y = [], []
+        self._prev_flat_grad = None
+        self._prev_step = None  # displacement applied at the previous call
+
+    def _gather(self):
+        return jnp.concatenate([p.grad._data.astype(jnp.float32).reshape(-1)
+                                for p in self._parameter_list])
+
+    def _distribute(self, flat):
+        off = 0
+        for p in self._parameter_list:
+            n = p._data.size
+            p._data = (p._data.astype(jnp.float32)
+                       + flat[off:off + n].reshape(p._data.shape)).astype(p._data.dtype)
+            off += n
+
+    def step(self, closure=None):
+        if closure is None:
+            # fall back to a plain gradient step
+            g = self._gather()
+            self._distribute(-self.get_lr() * g)
+            return None
+        loss = closure()
+        g = self._gather()
+        # curvature pair from the PREVIOUS step: s = x_k - x_{k-1}, y = g_k - g_{k-1}
+        if self._prev_flat_grad is not None and self._prev_step is not None:
+            y_new = g - self._prev_flat_grad
+            s_new = self._prev_step
+            if float(jnp.dot(y_new, s_new)) > 1e-10:  # keep B positive-definite
+                self._s.append(s_new)
+                self._y.append(y_new)
+                if len(self._s) > self._history:
+                    self._s.pop(0)
+                    self._y.pop(0)
+        # two-loop recursion
+        q = g
+        alphas = []
+        for s, y in zip(reversed(self._s), reversed(self._y)):
+            rho = 1.0 / jnp.maximum(jnp.dot(y, s), 1e-10)
+            a = rho * jnp.dot(s, q)
+            q = q - a * y
+            alphas.append((rho, a))
+        if self._y:
+            y_last, s_last = self._y[-1], self._s[-1]
+            gamma = jnp.dot(s_last, y_last) / jnp.maximum(jnp.dot(y_last, y_last), 1e-10)
+            q = gamma * q
+        for (rho, a), s, y in zip(reversed(alphas), self._s, self._y):
+            b = rho * jnp.dot(y, q)
+            q = q + (a - b) * s
+        step_dir = -q
+        lr = self.get_lr()
+        self._distribute(lr * step_dir)
+        self._prev_step = lr * step_dir
+        self._prev_flat_grad = g
+        return loss
+
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
+           "Adadelta", "RMSProp", "Lamb", "LBFGS", "lr"]

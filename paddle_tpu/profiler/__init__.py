@@ -1,0 +1,4 @@
+from .profiler import (Profiler, ProfilerState, ProfilerTarget, RecordEvent,  # noqa
+                       SortedKeys, export_chrome_tracing, load_profiler_result,
+                       make_scheduler)
+from .timer import Benchmark, benchmark  # noqa

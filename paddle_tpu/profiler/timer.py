@@ -1,0 +1,64 @@
+"""Throughput benchmark helper (reference: `python/paddle/profiler/timer.py:349` —
+`Benchmark`, ips reporting with reader cost vs batch cost)."""
+from __future__ import annotations
+
+import time
+
+
+class _Stat:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.total = 0.0
+        self.count = 0
+        self.last = 0.0
+
+    def record(self, v):
+        self.total += v
+        self.count += 1
+        self.last = v
+
+    @property
+    def avg(self):
+        return self.total / self.count if self.count else 0.0
+
+
+class Benchmark:
+    def __init__(self):
+        self.reader_cost = _Stat()
+        self.batch_cost = _Stat()
+        self._t_batch = None
+        self._t_reader = None
+        self.num_samples = 0
+
+    def begin(self):
+        self._t_batch = time.perf_counter()
+        self._t_reader = self._t_batch
+
+    def before_reader(self):
+        self._t_reader = time.perf_counter()
+
+    def after_reader(self):
+        if self._t_reader is not None:
+            self.reader_cost.record(time.perf_counter() - self._t_reader)
+
+    def after_step(self, num_samples=1):
+        now = time.perf_counter()
+        if self._t_batch is not None:
+            self.batch_cost.record(now - self._t_batch)
+            self.num_samples += num_samples
+        self._t_batch = now
+        self._t_reader = now
+
+    def step_info(self, unit="samples"):
+        ips = (1.0 / self.batch_cost.avg) if self.batch_cost.avg else 0.0
+        return (f"reader_cost: {self.reader_cost.avg:.5f} s, batch_cost: "
+                f"{self.batch_cost.avg:.5f} s, ips: {ips:.2f} {unit}/s")
+
+
+_bench = Benchmark()
+
+
+def benchmark():
+    return _bench

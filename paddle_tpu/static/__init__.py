@@ -1,0 +1,49 @@
+"""paddle.static compatibility surface.
+
+The reference's static graph (ProgramDesc + executors) maps to jit/to_static capture
+here; this module keeps the high-traffic static APIs importable: InputSpec, save/load
+inference model (delegating to jit.save/load), and name-scoped data declarations.
+"""
+from __future__ import annotations
+
+from .input_spec import InputSpec  # noqa
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+    from ..core import dtype as _dt
+    import numpy as np
+    shp = [1 if (s is None or s == -1) else s for s in shape]
+    t = Tensor(jnp.zeros(shp, _dt.to_np(dtype)))
+    t.name = name
+    return t
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, **kwargs):
+    raise NotImplementedError(
+        "static-graph save_inference_model: use paddle_tpu.jit.save on a Layer (the "
+        "to_static capture path replaces ProgramDesc serialization)")
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    from ..jit import load
+    return load(path_prefix)
+
+
+class Program:
+    """Placeholder Program object for API compat (the jaxpr is the real IR)."""
+
+    def __init__(self):
+        self._ops = []
+
+    def global_block(self):
+        return self
+
+
+def default_main_program():
+    return Program()
+
+
+def default_startup_program():
+    return Program()

@@ -1,0 +1,26 @@
+from . import dlpack  # noqa
+from . import unique_name  # noqa
+
+
+def try_import(name):
+    import importlib
+    try:
+        return importlib.import_module(name)
+    except ImportError as e:
+        raise ImportError(f"{name} is required: {e}")
+
+
+def run_check():
+    """paddle.utils.run_check parity: verify the accelerator works end to end."""
+    import jax
+    import jax.numpy as jnp
+    x = jnp.ones((128, 128))
+    y = (x @ x).block_until_ready()
+    dev = list(y.devices())[0]
+    print(f"paddle_tpu is installed successfully! device={dev.platform}:{dev.id}, "
+          f"matmul check sum={float(y.sum()):.1f}")
+
+
+def get_env_info():
+    import jax
+    return {"jax": jax.__version__, "devices": [str(d) for d in jax.devices()]}

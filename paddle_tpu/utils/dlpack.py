@@ -1,0 +1,20 @@
+"""DLPack interop (reference: `fluid/framework/dlpack_tensor.cc`, `paddle.utils.dlpack`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+def to_dlpack(x: Tensor):
+    return x._data.__dlpack__()
+
+
+def from_dlpack(capsule) -> Tensor:
+    if hasattr(capsule, "__dlpack__") and not isinstance(capsule, Tensor):
+        return Tensor(jnp.from_dlpack(capsule))
+    if isinstance(capsule, Tensor):
+        return capsule
+    arr = jax.dlpack.from_dlpack(capsule)
+    return Tensor(arr)

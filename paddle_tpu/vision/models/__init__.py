@@ -1,0 +1,5 @@
+from .lenet import LeNet  # noqa
+from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152, wide_resnet50_2  # noqa
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa
+from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa
+from .alexnet import AlexNet, alexnet  # noqa

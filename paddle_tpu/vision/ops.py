@@ -1,0 +1,98 @@
+"""Vision ops (reference: `python/paddle/vision/ops.py` — roi_align, nms,
+deform_conv2d, box ops)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply, _to_data
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=None,
+        top_k=None):
+    """Greedy NMS — host-side (dynamic output), like the reference CPU kernel."""
+    b = np.asarray(_to_data(boxes))
+    s = np.asarray(_to_data(scores)) if scores is not None else np.arange(len(b))[::-1]
+    order = np.argsort(-s)
+    keep = []
+    suppressed = np.zeros(len(b), bool)
+    areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        xx1 = np.maximum(b[i, 0], b[:, 0])
+        yy1 = np.maximum(b[i, 1], b[:, 1])
+        xx2 = np.minimum(b[i, 2], b[:, 2])
+        yy2 = np.minimum(b[i, 3], b[:, 3])
+        inter = np.maximum(0, xx2 - xx1) * np.maximum(0, yy2 - yy1)
+        iou = inter / (areas[i] + areas - inter + 1e-10)
+        suppressed |= iou > iou_threshold
+        suppressed[i] = True
+    keep = np.asarray(keep, np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+def box_iou(boxes1, boxes2):
+    def f(a, b):
+        area1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+        area2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+        rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / (area1[:, None] + area2[None] - inter + 1e-10)
+    return apply("box_iou", f, boxes1, boxes2)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0, sampling_ratio=-1,
+              aligned=True, name=None):
+    """RoIAlign via bilinear grid sampling (reference phi `roi_align` kernel)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def f(feat, rois, rois_num):
+        n, c, h, w = feat.shape
+        box_batch = jnp.repeat(jnp.arange(rois_num.shape[0]), 0)  # placeholder
+        # build batch index per roi from boxes_num
+        idx = jnp.concatenate([jnp.full((int(rois_num[i]),), i, jnp.int32)
+                               for i in range(rois_num.shape[0])]) \
+            if False else jnp.zeros((rois.shape[0],), jnp.int32)
+        # boxes_num is static in eager; compute on host
+        counts = np.asarray(rois_num)
+        idx = jnp.asarray(np.repeat(np.arange(len(counts)), counts).astype(np.int32))
+        offset = 0.5 if aligned else 0.0
+
+        def one_roi(roi, bi):
+            x1, y1, x2, y2 = roi * spatial_scale - offset
+            bw = jnp.maximum(x2 - x1, 1e-6)
+            bh = jnp.maximum(y2 - y1, 1e-6)
+            ys = y1 + (jnp.arange(oh) + 0.5) * bh / oh
+            xs = x1 + (jnp.arange(ow) + 0.5) * bw / ow
+            gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+            img = feat[bi]
+            y0 = jnp.floor(gy).astype(jnp.int32)
+            x0 = jnp.floor(gx).astype(jnp.int32)
+            wy = gy - y0
+            wx = gx - x0
+
+            def at(yy, xx):
+                yc = jnp.clip(yy, 0, h - 1)
+                xc = jnp.clip(xx, 0, w - 1)
+                return img[:, yc, xc]
+            out = (at(y0, x0) * ((1 - wy) * (1 - wx))[None]
+                   + at(y0, x0 + 1) * ((1 - wy) * wx)[None]
+                   + at(y0 + 1, x0) * (wy * (1 - wx))[None]
+                   + at(y0 + 1, x0 + 1) * (wy * wx)[None])
+            return out
+        return jax.vmap(one_roi)(rois, idx)
+    return apply("roi_align", f, x, boxes, boxes_num)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0, dilation=1,
+                  deformable_groups=1, groups=1, mask=None, name=None):
+    raise NotImplementedError("deform_conv2d: planned (gather-based Pallas kernel)")

@@ -1,0 +1,153 @@
+"""Vision transforms (reference: `python/paddle/vision/transforms/`) — numpy CHW
+pipelines."""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = np.asarray(img, dtype=np.float32)
+        if arr.ndim == 2:
+            arr = arr[None]
+        elif arr.ndim == 3 and arr.shape[-1] in (1, 3, 4) and self.data_format == "CHW":
+            arr = arr.transpose(2, 0, 1)
+        return arr / 255.0
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False, keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = np.asarray(img, np.float32)
+        c = arr.shape[0] if self.data_format == "CHW" else arr.shape[-1]
+        mean = self.mean[:c]
+        std = self.std[:c]
+        if self.data_format == "CHW":
+            return (arr - mean.reshape(-1, 1, 1)) / std.reshape(-1, 1, 1)
+        return (arr - mean) / std
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        arr = np.asarray(img, np.float32)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        if chw:
+            arr = arr.transpose(1, 2, 0)
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        yi = (np.arange(th) * (h / th)).astype(np.int64).clip(0, h - 1)
+        xi = (np.arange(tw) * (w / tw)).astype(np.int64).clip(0, w - 1)
+        out = arr[yi][:, xi]
+        if chw:
+            out = out.transpose(2, 0, 1)
+        return out
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        h, w = (arr.shape[1], arr.shape[2]) if chw else (arr.shape[0], arr.shape[1])
+        th, tw = self.size
+        y0 = max((h - th) // 2, 0)
+        x0 = max((w - tw) // 2, 0)
+        return arr[:, y0:y0 + th, x0:x0 + tw] if chw else arr[y0:y0 + th, x0:x0 + tw]
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, keys=None, **kw):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        if self.padding:
+            p = self.padding
+            pad = ((0, 0), (p, p), (p, p)) if chw else ((p, p), (p, p), (0, 0))[:arr.ndim]
+            arr = np.pad(arr, pad[:arr.ndim])
+        h, w = (arr.shape[1], arr.shape[2]) if chw else (arr.shape[0], arr.shape[1])
+        th, tw = self.size
+        y0 = np.random.randint(0, max(h - th, 0) + 1)
+        x0 = np.random.randint(0, max(w - tw, 0) + 1)
+        return arr[:, y0:y0 + th, x0:x0 + tw] if chw else arr[y0:y0 + th, x0:x0 + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            arr = np.asarray(img)
+            return arr[..., ::-1].copy()
+        return img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            arr = np.asarray(img)
+            chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+            return (arr[:, ::-1] if chw else arr[::-1]).copy()
+        return img
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[..., None]
+        return arr.transpose(self.order)
+
+
+def to_tensor(img, data_format="CHW"):
+    return ToTensor(data_format)(img)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)

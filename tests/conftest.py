@@ -1,0 +1,11 @@
+"""Test config: force the CPU backend with 8 virtual devices so multi-chip sharding
+paths compile and execute without TPU hardware (the reference's fake-device CI pattern,
+`test/custom_runtime/`)."""
+import os
+
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,61 @@
+"""OpTest harness (reference: `test/legacy_test/eager_op_test.py:379`).
+
+Each op test supplies a callable + numpy reference; `check_output` runs the op in
+eager AND under to_static capture and compares both against numpy (dual-mode parity,
+the reference's dygraph/static check); `check_grad` does numeric-vs-analytic gradient
+checking.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def check_output(fn, np_fn, inputs, atol=1e-5, rtol=1e-5, check_static=True):
+    tensors = [paddle.to_tensor(v) for v in inputs]
+    out = fn(*tensors)
+    expect = np_fn(*inputs)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    expects = expect if isinstance(expect, (tuple, list)) else [expect]
+    for o, e in zip(outs, expects):
+        np.testing.assert_allclose(np.asarray(o.numpy(), np.float64),
+                                   np.asarray(e, np.float64), atol=atol, rtol=rtol)
+    if check_static:
+        static_fn = paddle.jit.to_static(lambda *ts: fn(*ts))
+        sout = static_fn(*tensors)
+        souts = sout if isinstance(sout, (tuple, list)) else [sout]
+        for o, e in zip(souts, expects):
+            np.testing.assert_allclose(np.asarray(o.numpy(), np.float64),
+                                       np.asarray(e, np.float64), atol=atol, rtol=rtol)
+
+
+def check_grad(fn, inputs, input_idx=0, eps=1e-3, atol=1e-2, rtol=1e-2):
+    """Numeric vs analytic gradient on a scalarized output."""
+    tensors = [paddle.to_tensor(np.asarray(v, np.float32), stop_gradient=(i != input_idx))
+               for i, v in enumerate(inputs)]
+    out = fn(*tensors)
+    loss = out.sum() if out.size > 1 else out
+    loss.backward()
+    analytic = tensors[input_idx].grad.numpy().astype(np.float64)
+
+    base = np.asarray(inputs[input_idx], np.float64)
+    numeric = np.zeros_like(base)
+    flat = base.reshape(-1)
+    num_flat = numeric.reshape(-1)
+
+    def eval_at(vals):
+        args = [np.asarray(v, np.float32) for v in inputs]
+        args[input_idx] = vals.reshape(base.shape).astype(np.float32)
+        ts = [paddle.to_tensor(a) for a in args]
+        o = fn(*ts)
+        return float(np.sum(o.numpy().astype(np.float64)))
+
+    for i in range(flat.size):
+        plus = flat.copy()
+        plus[i] += eps
+        minus = flat.copy()
+        minus[i] -= eps
+        num_flat[i] = (eval_at(plus) - eval_at(minus)) / (2 * eps)
+    np.testing.assert_allclose(analytic, numeric, atol=atol, rtol=rtol)

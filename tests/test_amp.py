@@ -1,0 +1,61 @@
+"""AMP tests (reference: `test/amp/`)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def rnd(*s):
+    return np.random.RandomState(5).rand(*s).astype(np.float32)
+
+
+def test_autocast_white_list_casts_matmul():
+    x = paddle.to_tensor(rnd(4, 4))
+    y = paddle.to_tensor(rnd(4, 4))
+    with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+        out = paddle.matmul(x, y)
+    assert out.dtype.name == "bfloat16"
+    # black list stays fp32
+    with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+        s = paddle.nn.functional.softmax(x)
+    assert s.dtype.name == "float32"
+
+
+def test_autocast_off_outside_context():
+    x = paddle.to_tensor(rnd(4, 4))
+    out = paddle.matmul(x, x)
+    assert out.dtype.name == "float32"
+
+
+def test_grad_scaler_scales_and_unscales():
+    lin = nn.Linear(4, 4)
+    scaler = paddle.amp.GradScaler(init_loss_scaling=128.0)
+    x = paddle.to_tensor(rnd(2, 4))
+    loss = lin(x).sum()
+    scaler.scale(loss).backward()
+    g_scaled = lin.weight.grad.numpy().copy()
+    scaler.step(paddle.optimizer.SGD(learning_rate=0.0, parameters=lin.parameters()))
+    scaler.update()
+    # after unscale_, grads are divided by 128
+    np.testing.assert_allclose(lin.weight.grad.numpy(), g_scaled / 128.0, rtol=1e-6)
+
+
+def test_grad_scaler_skips_on_inf():
+    lin = nn.Linear(2, 2)
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=lin.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+    x = paddle.to_tensor(np.array([[np.inf, 1.0]], np.float32))
+    loss = lin(x).sum()
+    scaler.scale(loss).backward()
+    before = lin.weight.numpy().copy()
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_allclose(lin.weight.numpy(), before)  # update skipped
+    assert scaler.get_loss_scaling() < 4.0  # scale decreased
+
+
+def test_o2_decorate_casts_params():
+    net = nn.Sequential(nn.Linear(4, 4), nn.LayerNorm(4))
+    paddle.amp.decorate(net, level="O2", dtype="bfloat16")
+    assert net[0].weight.dtype.name == "bfloat16"
+    assert net[1].weight.dtype.name == "float32"  # norm excluded

@@ -1,0 +1,149 @@
+"""Autograd engine tests (reference category: eager/backward tests in
+`test/legacy_test/`)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+def t(a, sg=False):
+    return paddle.to_tensor(np.asarray(a, np.float32), stop_gradient=sg)
+
+
+def test_simple_chain():
+    x = t([2.0])
+    y = x * x + 3.0 * x
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+
+def test_fanout_accumulation():
+    x = t([3.0])
+    a = x * 2
+    b = x * 5
+    (a + b).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+
+def test_grad_accumulates_across_backwards():
+    x = t([1.0])
+    (x * 2).backward()
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_stop_gradient():
+    x = t([1.0])
+    y = t([2.0], sg=True)
+    z = x * y
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach():
+    x = t([2.0])
+    y = x * 3
+    d = y.detach()
+    assert d.stop_gradient
+    z = d * x
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_retain_graph_error():
+    x = t([2.0])
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward()  # allowed with retain_graph first
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_no_grad():
+    x = t([2.0])
+    with paddle.no_grad():
+        y = x * x
+    assert y.stop_gradient
+
+
+def test_partial_grad():
+    x = t([3.0])
+    y = t([4.0])
+    z = x * y
+    gx, = paddle.grad(z, [x])
+    np.testing.assert_allclose(gx.numpy(), [4.0])
+    assert x.grad is None  # grad() must not write .grad
+
+
+def test_partial_grad_intermediate():
+    x = t([2.0])
+    h = x * x
+    z = h * 3.0
+    gh, = paddle.grad(z, [h])
+    np.testing.assert_allclose(gh.numpy(), [3.0])
+
+
+def test_multi_output_op():
+    x = t([[3.0, 1.0, 2.0]])
+    vals, idx = paddle.topk(x, k=2)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1.0, 0.0, 1.0]])
+
+
+def test_hook():
+    x = t([2.0])
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    x.register_hook(hook)
+    (x * 3).backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_backward_nonscalar_with_grad_tensor():
+    x = t([1.0, 2.0])
+    y = x * x
+    y.backward(paddle.to_tensor(np.asarray([1.0, 0.5], np.float32)))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_pylayer():
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            (x,) = ctx.saved_tensor()
+            return grad * 2
+
+    x = t([3.0])
+    y = Double.apply(x)
+    np.testing.assert_allclose(y.numpy(), [6.0])
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_setitem_grad():
+    x = t([1.0, 2.0, 3.0])
+    v = t([10.0])
+    y = x * 1.0
+    y[1] = v
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0, 1.0])
+    np.testing.assert_allclose(v.grad.numpy(), [1.0])
+
+
+def test_indexing_grad():
+    x = t([[1.0, 2.0], [3.0, 4.0]])
+    y = x[0]
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1.0, 1.0], [0.0, 0.0]])

@@ -1,0 +1,85 @@
+"""End-to-end "book" test (reference: `test/book/test_recognize_digits.py` — train a
+small model to a loss threshold; the canonical framework-works gate)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.io import DataLoader
+from paddle_tpu.vision.datasets import MNIST
+from paddle_tpu.vision.transforms import Compose, Normalize, ToTensor
+
+TRANSFORM = Compose([ToTensor(), Normalize(mean=[0.5], std=[0.5])])
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(784, 128)
+        self.fc2 = nn.Linear(128, 64)
+        self.fc3 = nn.Linear(64, 10)
+
+    def forward(self, x):
+        x = paddle.reshape(x, [x.shape[0], 784])
+        x = F.relu(self.fc1(x))
+        x = F.relu(self.fc2(x))
+        return self.fc3(x)
+
+
+def test_mnist_mlp_trains_to_threshold():
+    paddle.seed(0)
+    train_ds = MNIST(mode="train", transform=TRANSFORM)
+    loader = DataLoader(train_ds, batch_size=64, shuffle=True, drop_last=True)
+    model = MLP()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=model.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+
+    first_loss = None
+    recent = []
+    steps = 0
+    for epoch in range(4):
+        for img, label in loader:
+            out = model(img)
+            loss = loss_fn(out, paddle.reshape(label, [-1]))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if first_loss is None:
+                first_loss = float(loss.numpy())
+            recent.append(float(loss.numpy()))
+            steps += 1
+            if steps >= 180:
+                break
+        if steps >= 180:
+            break
+    last_loss = float(np.mean(recent[-10:]))
+    assert first_loss > 1.5  # started near log(10)
+    assert last_loss < 0.8 * first_loss
+    assert last_loss < 1.2
+
+    # eval accuracy above chance by a wide margin
+    model.eval()
+    test_ds = MNIST(mode="test", transform=TRANSFORM)
+    correct = total = 0
+    with paddle.no_grad():
+        for img, label in DataLoader(test_ds, batch_size=256):
+            pred = model(img).numpy().argmax(-1)
+            correct += int((pred == label.numpy().reshape(-1)).sum())
+            total += pred.shape[0]
+    assert correct / total > 0.5
+
+
+def test_mnist_save_load_inference_roundtrip(tmp_path):
+    paddle.seed(1)
+    model = MLP()
+    model.eval()
+    x = paddle.to_tensor(np.random.rand(4, 1, 28, 28).astype(np.float32))
+    expect = model(x).numpy()
+
+    from paddle_tpu.static import InputSpec
+    path = str(tmp_path / "mnist_model")
+    paddle.jit.save(model, path, input_spec=[InputSpec([4, 1, 28, 28], "float32")])
+    loaded = paddle.jit.load(path)
+    got = loaded(x)
+    got_arr = got.numpy() if hasattr(got, "numpy") else got[0].numpy()
+    np.testing.assert_allclose(got_arr, expect, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,70 @@
+"""DataLoader/Dataset tests (reference: `test/legacy_test/test_dataloader_*`)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.io import (BatchSampler, DataLoader, Dataset, DistributedBatchSampler,
+                           IterableDataset, TensorDataset)
+
+
+class SquaresDataset(Dataset):
+    def __init__(self, n=20):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32(i), np.float32(i * i)
+
+    def __len__(self):
+        return self.n
+
+
+def test_basic_batching():
+    loader = DataLoader(SquaresDataset(), batch_size=4)
+    batches = list(loader)
+    assert len(batches) == 5
+    x, y = batches[0]
+    assert x.shape == [4]
+    np.testing.assert_allclose(y.numpy(), [0, 1, 4, 9])
+
+
+def test_shuffle_and_drop_last():
+    loader = DataLoader(SquaresDataset(10), batch_size=3, shuffle=True, drop_last=True)
+    batches = list(loader)
+    assert len(batches) == 3
+    seen = np.concatenate([b[0].numpy() for b in batches])
+    assert len(set(seen.tolist())) == 9
+
+
+def test_iterable_dataset():
+    class Stream(IterableDataset):
+        def __iter__(self):
+            for i in range(7):
+                yield np.float32(i)
+
+    loader = DataLoader(Stream(), batch_size=2)
+    batches = list(loader)
+    assert len(batches) == 4
+    assert batches[-1].shape == [1]
+
+
+def test_worker_prefetch_path():
+    loader = DataLoader(SquaresDataset(50), batch_size=5, num_workers=2)
+    batches = list(loader)
+    assert len(batches) == 10
+
+
+def test_tensor_dataset():
+    xs = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(6, 2))
+    ys = paddle.to_tensor(np.arange(6, dtype=np.int64))
+    ds = TensorDataset([xs, ys])
+    x0, y0 = ds[2]
+    np.testing.assert_allclose(x0.numpy(), [4, 5])
+
+
+def test_distributed_batch_sampler_shards():
+    ds = SquaresDataset(20)
+    s0 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=0)
+    s1 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=1)
+    idx0 = [i for b in s0 for i in b]
+    idx1 = [i for b in s1 for i in b]
+    assert len(idx0) == len(idx1) == 10
+    assert set(idx0).isdisjoint(set(idx1))

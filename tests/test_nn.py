@@ -1,0 +1,185 @@
+"""nn.Layer system + layer numerics (reference: layer tests in `test/legacy_test/`)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def rnd(*shape):
+    return np.random.RandomState(7).rand(*shape).astype(np.float32)
+
+
+def test_layer_registration():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+            self.register_buffer("counter", paddle.zeros([1]))
+
+        def forward(self, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    net = Net()
+    names = [n for n, _ in net.named_parameters()]
+    assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+    sd = net.state_dict()
+    assert "counter" in sd
+    assert len(sd) == 5
+    out = net(paddle.to_tensor(rnd(3, 4)))
+    assert out.shape == [3, 2]
+
+
+def test_state_dict_roundtrip():
+    net1 = nn.Linear(4, 3)
+    net2 = nn.Linear(4, 3)
+    net2.set_state_dict(net1.state_dict())
+    x = paddle.to_tensor(rnd(2, 4))
+    np.testing.assert_allclose(net1(x).numpy(), net2(x).numpy())
+
+
+def test_save_load_roundtrip(tmp_path):
+    net = nn.Linear(4, 3)
+    path = str(tmp_path / "model.pdparams")
+    paddle.save(net.state_dict(), path)
+    loaded = paddle.load(path)
+    net2 = nn.Linear(4, 3)
+    net2.set_state_dict(loaded)
+    x = paddle.to_tensor(rnd(2, 4))
+    np.testing.assert_allclose(net(x).numpy(), net2(x).numpy())
+
+
+def test_softmax_cross_entropy():
+    logits = rnd(4, 5) * 4
+    labels = np.array([0, 2, 1, 4], np.int64)
+    loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels))
+    # numpy reference
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    expect = -np.log(p[np.arange(4), labels]).mean()
+    np.testing.assert_allclose(loss.numpy(), expect, rtol=1e-5)
+
+
+def test_conv2d_matches_naive():
+    x = rnd(1, 2, 5, 5)
+    w = rnd(3, 2, 3, 3)
+    out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), padding=1)
+    assert out.shape == [1, 3, 5, 5]
+    # spot check one output position against direct correlation
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    expect = sum((xp[0, c, 1:4, 1:4] * w[0, c]).sum() for c in range(2))
+    np.testing.assert_allclose(out.numpy()[0, 0, 1, 1], expect, rtol=1e-4)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(3)
+    x = paddle.to_tensor(rnd(4, 3, 2, 2) * 5)
+    bn.train()
+    y = bn(x)
+    m = y.numpy().mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(m, np.zeros(3), atol=1e-4)
+    # running stats moved
+    assert not np.allclose(bn._mean.numpy(), np.zeros(3))
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == [4, 3, 2, 2]
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(8)
+    x = paddle.to_tensor(rnd(2, 4, 8) * 3)
+    y = ln(x).numpy()
+    np.testing.assert_allclose(y.mean(-1), np.zeros((2, 4)), atol=1e-5)
+    np.testing.assert_allclose(y.std(-1), np.ones((2, 4)), atol=1e-2)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    ids = paddle.to_tensor(np.array([[1, 2], [3, 9]], np.int64))
+    out = emb(ids)
+    assert out.shape == [2, 2, 4]
+    np.testing.assert_allclose(out.numpy()[0, 0], emb.weight.numpy()[1])
+    out.sum().backward()
+    assert emb.weight.grad is not None
+
+
+def test_pooling():
+    x = paddle.to_tensor(rnd(1, 1, 4, 4))
+    out = F.max_pool2d(x, 2)
+    expect = x.numpy().reshape(1, 1, 2, 2, 2, 2).max((3, 5))
+    np.testing.assert_allclose(out.numpy(), expect)
+    out2 = F.avg_pool2d(x, 2)
+    expect2 = x.numpy().reshape(1, 1, 2, 2, 2, 2).mean((3, 5))
+    np.testing.assert_allclose(out2.numpy(), expect2, rtol=1e-6)
+
+
+def test_adaptive_pool():
+    x = paddle.to_tensor(rnd(1, 2, 6, 6))
+    out = F.adaptive_avg_pool2d(x, 2)
+    assert out.shape == [1, 2, 2, 2]
+
+
+def test_dropout_modes():
+    x = paddle.to_tensor(np.ones((100, 100), np.float32))
+    y = F.dropout(x, 0.5, training=True)
+    kept = (y.numpy() != 0).mean()
+    assert 0.4 < kept < 0.6
+    # upscale keeps expectation
+    np.testing.assert_allclose(y.numpy().mean(), 1.0, atol=0.05)
+    y_eval = F.dropout(x, 0.5, training=False)
+    np.testing.assert_allclose(y_eval.numpy(), x.numpy())
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.to_tensor(rnd(2, 5, 16))
+    out = mha(x, x, x)
+    assert out.shape == [2, 5, 16]
+    out.sum().backward()
+    assert mha.q_proj.weight.grad is not None
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.to_tensor(rnd(2, 5, 16))
+    out = enc(x)
+    assert out.shape == [2, 5, 16]
+
+
+def test_lstm():
+    lstm = nn.LSTM(4, 8, num_layers=1)
+    x = paddle.to_tensor(rnd(2, 3, 4))
+    out, (h, c) = lstm(x)
+    assert out.shape == [2, 3, 8]
+    assert h.shape == [1, 2, 8]
+    out.sum().backward()
+
+
+def test_sequential_containers():
+    seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    assert len(seq) == 3
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    assert len(list(ll.parameters())) == 8
+
+
+def test_initializers_seeded():
+    paddle.seed(123)
+    l1 = nn.Linear(16, 16)
+    paddle.seed(123)
+    l2 = nn.Linear(16, 16)
+    np.testing.assert_allclose(l1.weight.numpy(), l2.weight.numpy())
+
+
+def test_clip_grad_by_global_norm():
+    from paddle_tpu.nn import ClipGradByGlobalNorm
+    p = paddle.to_tensor(rnd(3, 3), stop_gradient=False)
+    g = paddle.to_tensor(np.full((3, 3), 10.0, np.float32))
+    clip = ClipGradByGlobalNorm(1.0)
+    out = clip([(p, g)])
+    norm = np.linalg.norm(out[0][1].numpy())
+    np.testing.assert_allclose(norm, 1.0, rtol=1e-5)

@@ -1,0 +1,108 @@
+"""Op unit tests vs numpy (reference category: `test/legacy_test/` OpTest files)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+
+RNG = np.random.RandomState(42)
+
+
+def data(*shape):
+    return RNG.rand(*shape).astype(np.float32)
+
+
+UNARY_CASES = [
+    (paddle.exp, np.exp), (paddle.log, lambda x: np.log(x + 1.0)),
+    (paddle.sqrt, np.sqrt), (paddle.tanh, np.tanh), (paddle.abs, np.abs),
+    (paddle.floor, np.floor), (paddle.ceil, np.ceil), (paddle.sin, np.sin),
+    (paddle.cos, np.cos), (paddle.square, np.square),
+    (paddle.rsqrt, lambda x: 1.0 / np.sqrt(x)),
+    (paddle.reciprocal, lambda x: 1.0 / x), (paddle.expm1, np.expm1),
+    (paddle.log1p, np.log1p), (paddle.sign, np.sign),
+]
+
+
+@pytest.mark.parametrize("pfn,nfn", UNARY_CASES,
+                         ids=[f.__name__ for f, _ in UNARY_CASES])
+def test_unary(pfn, nfn):
+    x = data(3, 4) + 0.1
+    if pfn is paddle.log:
+        check_output(lambda t: pfn(t + 1.0), nfn, [x])
+    else:
+        check_output(pfn, nfn, [x])
+
+
+BINARY_CASES = [
+    (paddle.add, np.add), (paddle.subtract, np.subtract),
+    (paddle.multiply, np.multiply), (paddle.divide, np.divide),
+    (paddle.maximum, np.maximum), (paddle.minimum, np.minimum),
+    (paddle.pow, np.power), (paddle.atan2, np.arctan2),
+]
+
+
+@pytest.mark.parametrize("pfn,nfn", BINARY_CASES,
+                         ids=[f.__name__ for f, _ in BINARY_CASES])
+def test_binary(pfn, nfn):
+    x = data(3, 4) + 0.5
+    y = data(3, 4) + 0.5
+    check_output(pfn, nfn, [x, y])
+
+
+def test_broadcasting():
+    check_output(paddle.add, np.add, [data(3, 1, 4), data(2, 1)])
+
+
+def test_matmul():
+    check_output(paddle.matmul, np.matmul, [data(4, 5), data(5, 6)])
+    check_output(lambda a, b: paddle.matmul(a, b, transpose_y=True),
+                 lambda a, b: a @ b.T, [data(4, 5), data(6, 5)])
+    check_output(paddle.matmul, np.matmul, [data(2, 3, 4), data(2, 4, 5)])
+
+
+def test_reductions():
+    x = data(3, 4, 5)
+    check_output(lambda t: paddle.sum(t), lambda a: np.sum(a), [x])
+    check_output(lambda t: paddle.sum(t, axis=1), lambda a: np.sum(a, 1), [x])
+    check_output(lambda t: paddle.mean(t, axis=[0, 2]),
+                 lambda a: np.mean(a, (0, 2)), [x])
+    check_output(lambda t: paddle.max(t, axis=1, keepdim=True),
+                 lambda a: np.max(a, 1, keepdims=True), [x])
+    check_output(lambda t: paddle.prod(t, axis=-1), lambda a: np.prod(a, -1), [x])
+    check_output(lambda t: paddle.logsumexp(t, axis=1),
+                 lambda a: np.log(np.sum(np.exp(a), 1)), [x])
+
+
+def test_cumsum():
+    x = data(3, 4)
+    check_output(lambda t: paddle.cumsum(t, axis=1), lambda a: np.cumsum(a, 1), [x])
+    check_output(lambda t: paddle.cumsum(t), lambda a: np.cumsum(a.reshape(-1)), [x])
+
+
+def test_clip_scale():
+    x = data(3, 4)
+    check_output(lambda t: paddle.clip(t, 0.2, 0.8), lambda a: np.clip(a, 0.2, 0.8), [x])
+    check_output(lambda t: paddle.scale(t, 2.0, 1.0), lambda a: a * 2 + 1, [x])
+
+
+def test_stat():
+    x = data(4, 5)
+    check_output(lambda t: paddle.var(t, axis=1), lambda a: np.var(a, 1, ddof=1), [x])
+    check_output(lambda t: paddle.std(t), lambda a: np.std(a, ddof=1), [x], atol=1e-4)
+    check_output(lambda t: paddle.median(t, axis=1), lambda a: np.median(a, 1), [x])
+
+
+def test_grad_unary():
+    check_grad(paddle.tanh, [data(3, 3)])
+    check_grad(paddle.exp, [data(3, 3)])
+    check_grad(lambda t: paddle.sqrt(t + 0.5), [data(3, 3)])
+
+
+def test_grad_matmul():
+    check_grad(paddle.matmul, [data(3, 4), data(4, 2)], input_idx=0)
+    check_grad(paddle.matmul, [data(3, 4), data(4, 2)], input_idx=1)
+
+
+def test_grad_reduction():
+    check_grad(lambda t: paddle.mean(t, axis=0), [data(4, 3)])
+    check_grad(lambda t: paddle.max(t, axis=1), [data(4, 3)])

@@ -1,0 +1,110 @@
+"""Regression tests for review findings (round 1 code review)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def t(a, sg=False):
+    return paddle.to_tensor(np.asarray(a, np.float32), stop_gradient=sg)
+
+
+def test_backward_through_multioutput_with_unused_int_output():
+    # topk returns (values, int indices); unused indices must not break backward
+    x = t([[3.0, 1.0, 2.0]])
+    vals, idx = paddle.topk(x, k=2)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1.0, 0.0, 1.0]])
+    # kthvalue too
+    x2 = t([[3.0, 1.0, 2.0]])
+    v, i = paddle.kthvalue(x2, 2)
+    v.sum().backward()
+    assert x2.grad is not None
+
+
+def test_setitem_into_stop_gradient_tensor_keeps_graph():
+    y = paddle.zeros([4])
+    assert y.stop_gradient
+    x = t([5.0])
+    y[0] = x
+    assert not y.stop_gradient
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0])
+
+
+def test_adamw_zero_weight_decay_int():
+    p = t([1.0])
+    opt = paddle.optimizer.AdamW(learning_rate=0.0, parameters=[p], weight_decay=0)
+    assert opt._coeff == 0.0
+    (p * p).sum().backward()
+    before = p.numpy().copy()
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), before)  # lr=0, wd=0 -> no movement
+
+
+def test_tensor_T_reverses_all_dims():
+    x = paddle.to_tensor(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    assert x.T.shape == [4, 3, 2]
+    assert x.mT.shape == [2, 4, 3]
+    np.testing.assert_allclose(x.T.numpy(), x.numpy().T)
+
+
+def test_clear_grad_set_to_zero():
+    p = t([2.0])
+    (p * p).backward()
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+    opt.clear_grad(set_to_zero=True)
+    assert p.grad is not None
+    np.testing.assert_allclose(p.grad.numpy(), [0.0])
+    opt.clear_grad(set_to_zero=False)
+    assert p.grad is None
+
+
+def test_grad_allow_unused_raises():
+    x = t([1.0])
+    y = t([1.0])
+    z = x * 2
+    with pytest.raises(ValueError):
+        paddle.grad(z, [x, y], allow_unused=False)
+    z = x * 2  # fresh graph: the failed call above consumed the old tape
+    gx, gy = paddle.grad(z, [x, y], allow_unused=True)
+    assert gy is None
+    np.testing.assert_allclose(gx.numpy(), [2.0])
+
+
+def test_retain_grads_on_intermediate():
+    x = t([2.0])
+    h = x * x
+    h.retain_grads()
+    z = h * 3.0
+    z.backward()
+    assert h.grad is not None
+    np.testing.assert_allclose(h.grad.numpy(), [3.0])
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+
+def test_nonleaf_hook_fires():
+    x = t([2.0])
+    h = x * x
+    seen = []
+    h.register_hook(lambda g: seen.append(g.numpy().copy()))
+    (h * 3.0).backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], [3.0])
+
+
+def test_lbfgs_converges_on_quadratic():
+    x = t(np.array([5.0, -3.0]))
+    x.persistable = True
+    opt = paddle.optimizer.LBFGS(learning_rate=0.5, parameters=[x])
+
+    def closure():
+        opt.clear_grad(set_to_zero=False)
+        loss = ((x - 1.0) ** 2).sum()
+        loss.backward()
+        return loss
+
+    for _ in range(20):
+        loss = opt.step(closure)
+    assert float(loss.numpy()) < 1e-3
