@@ -1,0 +1,5 @@
+from .callbacks import (Callback, EarlyStopping, LRScheduler, ModelCheckpoint,  # noqa
+                        ProgBarLogger, VisualDL)
+from .model import Model  # noqa
+from .summary import summary  # noqa
+from .flops import flops  # noqa

@@ -1,0 +1,45 @@
+"""paddle.flops (reference: `python/paddle/hapi/dynamic_flops.py`)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    total = [0]
+    hooks = []
+
+    def conv_hook(layer, inputs, outputs):
+        out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+        w = layer.weight
+        k = int(np.prod(w.shape[1:]))
+        total[0] += 2 * k * int(np.prod(out.shape))
+
+    def linear_hook(layer, inputs, outputs):
+        w = layer.weight
+        out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+        total[0] += 2 * int(np.prod(out.shape)) * w.shape[0]
+
+    from ..nn.layer.common import Linear
+    from ..nn.layer.conv import _ConvND
+    for _, layer in net.named_sublayers(include_self=True):
+        if isinstance(layer, _ConvND):
+            hooks.append(layer.register_forward_post_hook(conv_hook))
+        elif isinstance(layer, Linear):
+            hooks.append(layer.register_forward_post_hook(linear_hook))
+
+    x = Tensor(np.zeros([1 if (s is None or s == -1) else s for s in input_size],
+                        np.float32))
+    was_training = net.training
+    net.eval()
+    try:
+        net(x)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+    if print_detail:
+        print(f"FLOPs: {total[0]:,}")
+    return total[0]

@@ -1,0 +1,194 @@
+"""hapi Model (reference: `python/paddle/hapi/model.py` — Keras-like
+fit/evaluate/predict over a Layer)."""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+from ..io import DataLoader
+from .callbacks import CallbackList, ProgBarLogger
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else \
+            ([metrics] if metrics else [])
+
+    # ---- single-batch ops ----
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outs = self.network(*[_to_tensor(x) for x in inputs])
+        losses = self._compute_loss(outs, labels)
+        losses.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outs, labels)
+        return [float(losses.numpy())] + metrics
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outs = self.network(*[_to_tensor(x) for x in inputs])
+        losses = self._compute_loss(outs, labels)
+        metrics = self._update_metrics(outs, labels)
+        return [float(losses.numpy())] + metrics
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outs = self.network(*[_to_tensor(x) for x in inputs])
+        return [o.numpy() if isinstance(o, Tensor) else o
+                for o in (outs if isinstance(outs, (list, tuple)) else [outs])]
+
+    def _compute_loss(self, outs, labels):
+        if self._loss is None:
+            return outs if isinstance(outs, Tensor) else outs[0]
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        labels = [_to_tensor(l) for l in labels]
+        out_list = outs if isinstance(outs, (list, tuple)) else [outs]
+        return self._loss(*out_list, *labels)
+
+    def _update_metrics(self, outs, labels):
+        res = []
+        out0 = outs[0] if isinstance(outs, (list, tuple)) else outs
+        lab0 = labels[0] if isinstance(labels, (list, tuple)) else labels
+        for m in self._metrics:
+            correct = m.compute(out0, _to_tensor(lab0))
+            r = m.update(correct.numpy() if isinstance(correct, Tensor) else correct)
+            res.append(r if not isinstance(r, (list, tuple)) else r[0])
+        return res
+
+    # ---- loops ----
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None, **kw):
+        train_loader = train_data if isinstance(train_data, DataLoader) else \
+            DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
+                       drop_last=drop_last, num_workers=num_workers)
+        eval_loader = None
+        if eval_data is not None:
+            eval_loader = eval_data if isinstance(eval_data, DataLoader) else \
+                DataLoader(eval_data, batch_size=batch_size, num_workers=num_workers)
+        cbks = CallbackList((callbacks or []) + ([ProgBarLogger(log_freq, verbose)]
+                                                if verbose else []))
+        cbks.set_model(self)
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            steps = None
+        cbks.set_params({"epochs": epochs, "steps": steps, "verbose": verbose,
+                         "metrics": self._metrics_names()})
+        cbks.on_train_begin()
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, data in enumerate(train_loader):
+                cbks.on_train_batch_begin(step)
+                ins, labs = _split_batch(data)
+                vals = self.train_batch(ins, labs)
+                logs = dict(zip(self._metrics_names(), vals))
+                logs["step"] = step
+                cbks.on_train_batch_end(step, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0, _callbacks=cbks)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(os.path.join(save_dir, str(epoch)))
+        cbks.on_train_end()
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, _callbacks=None, **kw):
+        loader = eval_data if isinstance(eval_data, DataLoader) else \
+            DataLoader(eval_data, batch_size=batch_size, num_workers=num_workers)
+        for m in self._metrics:
+            m.reset()
+        logs = {}
+        total = 0.0
+        n = 0
+        for data in loader:
+            ins, labs = _split_batch(data)
+            vals = self.eval_batch(ins, labs)
+            total += vals[0]
+            n += 1
+            logs = dict(zip(self._metrics_names(), vals))
+        logs["loss"] = total / max(n, 1)
+        for m in self._metrics:
+            logs[m.name()] = m.accumulate()
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                callbacks=None, verbose=1, **kw):
+        loader = test_data if isinstance(test_data, DataLoader) else \
+            DataLoader(test_data, batch_size=batch_size, num_workers=num_workers)
+        outputs = []
+        for data in loader:
+            ins, _ = _split_batch(data, has_label=False)
+            try:
+                outputs.append(self.predict_batch(ins))
+            except TypeError:
+                # dataset yields (inputs..., label): drop the trailing label
+                outputs.append(self.predict_batch(ins[:-1]))
+        if stack_outputs:
+            k = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs]) for i in range(k)]
+        return outputs
+
+    def _metrics_names(self):
+        return ["loss"] + [m.name() for m in self._metrics]
+
+    # ---- persistence ----
+    def save(self, path, training=True):
+        from ..framework.io import save as psave
+        psave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            psave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as pload
+        self.network.set_state_dict(pload(path + ".pdparams"))
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(pload(path + ".pdopt"))
+
+    def parameters(self, *a, **kw):
+        return self.network.parameters(*a, **kw)
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as _s
+        return _s(self.network, input_size)
+
+
+def _to_tensor(x):
+    if isinstance(x, Tensor) or x is None:
+        return x
+    return Tensor(np.asarray(x))
+
+
+def _split_batch(data, has_label=True):
+    if isinstance(data, (list, tuple)):
+        if has_label and len(data) >= 2:
+            return list(data[:-1]), data[-1]
+        return list(data), None
+    return [data], None

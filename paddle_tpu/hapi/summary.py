@@ -1,0 +1,60 @@
+"""paddle.summary (reference: `python/paddle/hapi/model_summary.py`)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    rows = []
+    hooks = []
+
+    def make_hook(name):
+        def hook(layer, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+            n_params = sum(int(np.prod(p.shape)) for p in layer._parameters.values()
+                           if p is not None)
+            shape = list(out.shape) if isinstance(out, Tensor) else "?"
+            rows.append((name, type(layer).__name__, shape, n_params))
+        return hook
+
+    for name, layer in net.named_sublayers(include_self=False):
+        if not layer._sub_layers:  # leaves only
+            hooks.append(layer.register_forward_post_hook(make_hook(name)))
+
+    if input is not None:
+        x = input if isinstance(input, (list, tuple)) else [input]
+    else:
+        if isinstance(input_size, tuple) and input_size and \
+                isinstance(input_size[0], (tuple, list)):
+            shapes = input_size
+        else:
+            shapes = [input_size]
+        x = [Tensor(np.zeros([1 if (s is None or s == -1) else s for s in shape],
+                             np.float32)) for shape in shapes]
+    was_training = net.training
+    net.eval()
+    try:
+        net(*x)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+    line = "-" * 80
+    print(line)
+    print(f"{'Layer (type)':<35} {'Output Shape':<25} {'Param #':<12}")
+    print(line)
+    for name, tname, shape, n in rows:
+        print(f"{name + ' (' + tname + ')':<35} {str(shape):<25} {n:<12}")
+    print(line)
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print(line)
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,3 +1,4 @@
+from . import distributed  # noqa
 from . import nn  # noqa
 from .nn import functional  # noqa
 

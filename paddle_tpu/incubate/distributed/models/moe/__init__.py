@@ -1,0 +1,3 @@
+from .gate import GShardGate, NaiveGate, SwitchGate  # noqa
+from .moe_layer import MoELayer  # noqa
+from .grad_clip import ClipGradForMOEByGlobalNorm  # noqa

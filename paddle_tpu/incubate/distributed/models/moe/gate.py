@@ -1,0 +1,96 @@
+"""MoE gates (reference: `incubate/distributed/models/moe/gate/` — naive, gshard,
+switch)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....core.tensor import Tensor, apply
+from .....nn import functional as F
+from .....nn.initializer import XavierNormal
+from .....nn.layer.layers import Layer
+
+
+class BaseGate(Layer):
+    def __init__(self, d_model, num_expert, world_size=1, topk=2):
+        super().__init__()
+        self.d_model = d_model
+        self.num_expert = num_expert
+        self.world_size = world_size
+        self.tot_expert = num_expert * world_size
+        self.topk = topk
+        self.loss = None
+
+    def get_loss(self, clear=True):
+        loss = self.loss
+        if clear:
+            self.loss = None
+        return loss
+
+
+class NaiveGate(BaseGate):
+    def __init__(self, d_model, num_expert, world_size=1, topk=2):
+        super().__init__(d_model, num_expert, world_size, topk)
+        self.gate_weight = self.create_parameter(
+            [d_model, self.tot_expert], default_initializer=XavierNormal())
+
+    def forward(self, inp):
+        logits = inp.matmul(self.gate_weight)
+        from .....ops.search import topk as _topk
+        vals, idx = _topk(logits, self.topk, axis=-1)
+        return idx, F.softmax(vals, axis=-1)
+
+
+class GShardGate(NaiveGate):
+    """Top-2 gate with load-balancing aux loss (reference gshard_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2,
+                 capacity=(1.2, 2.4), random_routing=True, group=None):
+        super().__init__(d_model, num_expert, world_size, topk)
+        self.capacity = capacity
+
+    def forward(self, inp):
+        logits = inp.matmul(self.gate_weight)
+        probs = F.softmax(logits, axis=-1)
+        from .....ops.search import topk as _topk
+        vals, idx = _topk(probs, self.topk, axis=-1)
+        # aux loss: mean_prob_per_expert * frac_tokens_per_expert * E
+        E = self.tot_expert
+
+        def aux(p, top1):
+            me = jnp.mean(p, axis=0)
+            ce = jnp.mean(jax.nn.one_hot(top1.astype(jnp.int32), E), axis=0)
+            return jnp.sum(me * ce) * E
+        self.loss = apply("gshard_aux_loss", aux, probs, idx[:, 0])
+        denom = vals.sum(axis=-1, keepdim=True)
+        return idx, vals / denom
+
+
+class SwitchGate(BaseGate):
+    """Top-1 switch gate (reference switch_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=1, switch_eps=0.1,
+                 capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, world_size, 1)
+        self.switch_eps = switch_eps
+        self.gate_weight = self.create_parameter(
+            [d_model, self.tot_expert], default_initializer=XavierNormal())
+
+    def forward(self, inp):
+        logits = inp.matmul(self.gate_weight)
+        if self.training and self.switch_eps > 0:
+            from .....ops.random import uniform
+            noise = uniform(logits.shape, min=1.0 - self.switch_eps,
+                            max=1.0 + self.switch_eps)
+            logits = logits * noise
+        probs = F.softmax(logits, axis=-1)
+        from .....ops.search import topk as _topk
+        vals, idx = _topk(probs, 1, axis=-1)
+        E = self.tot_expert
+
+        def aux(p, top1):
+            me = jnp.mean(p, axis=0)
+            ce = jnp.mean(jax.nn.one_hot(top1.astype(jnp.int32), E), axis=0)
+            return jnp.sum(me * ce) * E
+        self.loss = apply("switch_aux_loss", aux, probs, idx[:, 0])
+        return idx, vals

@@ -1,0 +1,39 @@
+"""MoE-aware global-norm clip (reference: moe/grad_clip.py — expert params' norms
+reduced over the moe group, shared params counted once)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .....core.tensor import Tensor
+from .....nn.clip import ClipGradByGlobalNorm
+
+
+class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):
+    def __init__(self, clip_norm, is_expert_param_func=None, moe_group=None,
+                 group_name="default_moe_group"):
+        super().__init__(clip_norm, group_name)
+        self.is_expert_fn = is_expert_param_func or (
+            lambda p: getattr(p, "is_expert", False))
+        self.moe_group = moe_group
+
+    def _dygraph_clip(self, params_grads):
+        normal_sq = []
+        expert_sq = []
+        for p, g in params_grads:
+            if g is None:
+                continue
+            sq = jnp.sum(jnp.square(g._data.astype(jnp.float32)))
+            (expert_sq if self.is_expert_fn(p) else normal_sq).append(sq)
+        total_sq = sum(normal_sq) + sum(expert_sq) if (normal_sq or expert_sq) else None
+        if total_sq is None:
+            return params_grads
+        if self.moe_group is not None and self.moe_group.nranks > 1:
+            from .....distributed.communication.ops import ReduceOp, all_reduce
+            e = Tensor(jnp.asarray(sum(expert_sq) if expert_sq else 0.0))
+            all_reduce(e, ReduceOp.SUM, group=self.moe_group)
+            total_sq = sum(normal_sq) + e._data
+        global_norm = jnp.sqrt(total_sq)
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return [(p, g if g is None else Tensor((g._data * scale).astype(g._data.dtype),
+                                               stop_gradient=True))
+                for p, g in params_grads]

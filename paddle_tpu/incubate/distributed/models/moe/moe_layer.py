@@ -1,0 +1,99 @@
+"""MoELayer (reference: `incubate/distributed/models/moe/moe_layer.py` — capacity-based
+dispatch via `global_scatter`/`global_gather` alltoall ops).
+
+TPU-native: dispatch is a dense einsum against a one-hot capacity-slotted combine
+tensor (the GShard formulation) — static shapes, MXU-friendly, and under the hybrid
+trainer the expert dimension shards over the mesh's expert axis so XLA lowers the
+dispatch/combine einsums to the same all-to-all the reference codes by hand.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....core.tensor import Tensor, apply
+from .....nn.layer.layers import Layer
+from .gate import GShardGate, NaiveGate, SwitchGate
+
+
+def dispatch_and_combine(x, gate_idx, gate_val, experts_fn, num_expert, capacity):
+    """Functional GShard dispatch: x [T, D]; gate_idx [T, k]; gate_val [T, k]."""
+    T, D = x.shape
+    k = gate_idx.shape[1]
+    E, C = num_expert, capacity
+
+    onehot = jax.nn.one_hot(gate_idx.astype(jnp.int32), E, dtype=jnp.float32)  # [T,k,E]
+    # position of each token within its expert queue
+    pos = jnp.cumsum(onehot.reshape(T * k, E), axis=0).reshape(T, k, E) * onehot - 1.0
+    keep = (pos < C) & (onehot > 0)
+    pos = jnp.clip(pos, 0, C - 1).astype(jnp.int32)
+    # combine weights [T, k, E, C]
+    capslot = jax.nn.one_hot(pos, C, dtype=jnp.float32) * keep[..., None]
+    combine = jnp.einsum("tk,tkec->tec", gate_val.astype(jnp.float32), capslot)
+    dispatch = (combine > 0).astype(x.dtype)  # [T, E, C]
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x)  # [E, C, D]
+    expert_out = experts_fn(expert_in)  # [E, C, D]
+    out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
+    return out
+
+
+class MoELayer(Layer):
+    """(reference MoELayer): gate + per-rank experts + alltoall dispatch.
+
+    `experts` is a list of Layers, each mapping [*, D] -> [*, D].
+    """
+
+    def __init__(self, d_model, experts=None, gate=None, moe_group=None, mp_group=None,
+                 recompute_interval=0, capacity_factor=1.2, topk=2, **kwargs):
+        super().__init__()
+        from .....nn.layer.container import LayerList
+        self.d_model = d_model
+        self.experts = experts if isinstance(experts, LayerList) else LayerList(experts)
+        self.num_expert = len(self.experts)
+        self.capacity_factor = capacity_factor
+        if gate is None or gate == "naive":
+            gate = NaiveGate(d_model, self.num_expert, topk=topk)
+        elif gate == "gshard":
+            gate = GShardGate(d_model, self.num_expert, topk=topk)
+        elif gate == "switch":
+            gate = SwitchGate(d_model, self.num_expert)
+        self.gate = gate
+
+    def forward(self, x):
+        orig_shape = x.shape
+        x2 = x.reshape([-1, self.d_model])
+        T = x2.shape[0]
+        gate_idx, gate_val = self.gate(x2)
+        C = max(int(self.capacity_factor * T * self.gate.topk / self.num_expert), 4)
+        out = self._forward_eager(x2, gate_idx, gate_val, C)
+        return out.reshape(orig_shape)
+
+    def _forward_eager(self, x2, gate_idx, gate_val, C):
+        from .....ops.creation import zeros
+        from .....ops.manipulation import concat
+        E = self.num_expert
+        T = x2.shape[0]
+        k = gate_idx.shape[1]
+
+        def build_combine(idx, val):
+            onehot = jax.nn.one_hot(idx.astype(jnp.int32), E, dtype=jnp.float32)
+            pos = jnp.cumsum(onehot.reshape(T * k, E), axis=0).reshape(T, k, E) * onehot - 1.0
+            keep = (pos < C) & (onehot > 0)
+            posc = jnp.clip(pos, 0, C - 1).astype(jnp.int32)
+            capslot = jax.nn.one_hot(posc, C, dtype=jnp.float32) * keep[..., None]
+            return jnp.einsum("tk,tkec->tec", val.astype(jnp.float32), capslot)
+
+        combine = apply("moe_combine", build_combine, gate_idx, gate_val)
+        dispatch = apply("moe_dispatch", lambda c: (c > 0).astype(x2._data.dtype),
+                         combine)
+        expert_in = apply("moe_scatter", lambda d, xx: jnp.einsum("tec,td->ecd", d, xx),
+                          dispatch, x2)
+        outs = []
+        for e, expert in enumerate(self.experts):
+            outs.append(expert(expert_in[e]))
+        from .....ops.manipulation import stack
+        expert_out = stack(outs, axis=0)
+        out = apply("moe_gather",
+                    lambda c, eo: jnp.einsum("tec,ecd->td", c.astype(eo.dtype), eo),
+                    combine, expert_out)
+        return out

@@ -1,0 +1,2 @@
+from . import gpt  # noqa
+from .gpt import GPTConfig, GPTForCausalLM, gpt3_1p3b, gpt_tiny  # noqa

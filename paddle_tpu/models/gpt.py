@@ -1,0 +1,250 @@
+"""GPT family — the flagship model (baseline ladder #4: GPT-3 1.3B hybrid parallel).
+
+Two faces over one implementation:
+- a pure-functional core (`init_params` / `forward` / `loss_fn`) over a stacked-block
+  params pytree — the compiled hybrid-parallel trainer consumes this directly;
+- a `GPTForCausalLM` nn.Layer wrapper exposing the eager paddle-style API.
+
+TPU-native choices: blocks are stacked on a leading L axis and run under `lax.scan`
+(one compiled block, XLA-friendly, and the L axis is what pipeline parallelism
+shards); attention is the Pallas flash kernel; norms hit the fused RMSNorm kernel;
+RoPE is fused into the attention prologue.  Mirrors the reference's GPT in
+PaddleNLP structure (embed -> L x [ln, attn, ln, mlp] -> ln -> tied lm head).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..incubate.kernels.flash_attention import flash_attention_fused
+from ..incubate.kernels.rms_norm import rms_norm_fused
+from ..incubate.kernels.rope import apply_rope
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 2048
+    num_layers: int = 24
+    num_heads: int = 16
+    max_seq_len: int = 2048
+    intermediate_size: Optional[int] = None
+    use_rope: bool = True
+    use_rms_norm: bool = False  # GPT-3 uses LayerNorm; llama preset flips this
+    activation: str = "gelu"
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = True
+    dtype: Any = jnp.float32
+
+    @property
+    def ffn_size(self):
+        return self.intermediate_size or 4 * self.hidden_size
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+
+def gpt3_1p3b():
+    """GPT-3 1.3B config (baseline ladder #4)."""
+    return GPTConfig(vocab_size=50304, hidden_size=2048, num_layers=24, num_heads=16,
+                     max_seq_len=2048)
+
+
+def gpt_tiny(seq_len=128):
+    return GPTConfig(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                     max_seq_len=seq_len)
+
+
+# ---------------------------------------------------------------------------
+# functional core
+# ---------------------------------------------------------------------------
+
+def init_params(config: GPTConfig, key) -> Dict[str, Any]:
+    c = config
+    D, L, F, V = c.hidden_size, c.num_layers, c.ffn_size, c.vocab_size
+    k = iter(jax.random.split(key, 16))
+    std = c.initializer_range
+    proj_std = std / math.sqrt(2 * L)  # GPT-2/3 residual-scaled init
+
+    def norm_pair(shape):
+        return jnp.ones(shape, c.dtype), jnp.zeros(shape, c.dtype)
+
+    ln1_w, ln1_b = norm_pair((L, D))
+    ln2_w, ln2_b = norm_pair((L, D))
+    lnf_w, lnf_b = norm_pair((D,))
+    params = {
+        "wte": (jax.random.normal(next(k), (V, D)) * std).astype(c.dtype),
+        "blocks": {
+            "ln1_w": ln1_w, "ln1_b": ln1_b,
+            "qkv_w": (jax.random.normal(next(k), (L, D, 3 * D)) * std).astype(c.dtype),
+            "qkv_b": jnp.zeros((L, 3 * D), c.dtype),
+            "proj_w": (jax.random.normal(next(k), (L, D, D)) * proj_std).astype(c.dtype),
+            "proj_b": jnp.zeros((L, D), c.dtype),
+            "ln2_w": ln2_w, "ln2_b": ln2_b,
+            "fc1_w": (jax.random.normal(next(k), (L, D, F)) * std).astype(c.dtype),
+            "fc1_b": jnp.zeros((L, F), c.dtype),
+            "fc2_w": (jax.random.normal(next(k), (L, F, D)) * proj_std).astype(c.dtype),
+            "fc2_b": jnp.zeros((L, D), c.dtype),
+        },
+        "lnf_w": lnf_w, "lnf_b": lnf_b,
+    }
+    if not c.use_rope:
+        params["wpe"] = (jax.random.normal(next(k), (c.max_seq_len, D)) * std).astype(c.dtype)
+    if not c.tie_word_embeddings:
+        params["lm_head"] = (jax.random.normal(next(k), (D, V)) * std).astype(c.dtype)
+    return params
+
+
+def _norm(x, w, b, config):
+    if config.use_rms_norm:
+        return rms_norm_fused(x, w)
+    mu = jnp.mean(x.astype(jnp.float32), axis=-1, keepdims=True)
+    var = jnp.var(x.astype(jnp.float32), axis=-1, keepdims=True)
+    out = (x.astype(jnp.float32) - mu) * jax.lax.rsqrt(var + 1e-5)
+    return (out * w + b).astype(x.dtype)
+
+
+def _rope_tables(config, S):
+    D = config.head_dim
+    inv = 1.0 / (10000.0 ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
+    t = jnp.arange(S, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)
+    return jnp.sin(freqs), jnp.cos(freqs)
+
+
+def block_forward(bp, x, config: GPTConfig, mp_constraint=None):
+    """One transformer block; bp holds this block's (unstacked) weights.
+
+    mp_constraint: optional callable applying sharding constraints on activations
+    (set by the hybrid trainer to pin the tensor-parallel layout).
+    """
+    c = config
+    B, S, D = x.shape
+    H, hd = c.num_heads, c.head_dim
+
+    h = _norm(x, bp["ln1_w"], bp["ln1_b"], c)
+    qkv = jnp.matmul(h, bp["qkv_w"]) + bp["qkv_b"]
+    if mp_constraint:
+        qkv = mp_constraint(qkv, "hidden_mp")
+    q, kk, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, S, H, hd)
+    kk = kk.reshape(B, S, H, hd)
+    v = v.reshape(B, S, H, hd)
+    if c.use_rope:
+        sin, cos = _rope_tables(c, S)
+        q = apply_rope(q, sin, cos)
+        kk = apply_rope(kk, sin, cos)
+    attn = flash_attention_fused(q, kk, v, causal=True)
+    attn = attn.reshape(B, S, D)
+    attn = jnp.matmul(attn, bp["proj_w"]) + bp["proj_b"]
+    x = x + attn
+
+    h = _norm(x, bp["ln2_w"], bp["ln2_b"], c)
+    h = jnp.matmul(h, bp["fc1_w"]) + bp["fc1_b"]
+    if mp_constraint:
+        h = mp_constraint(h, "ffn_mp")
+    h = jax.nn.gelu(h) if c.activation == "gelu" else jax.nn.silu(h)
+    h = jnp.matmul(h, bp["fc2_w"]) + bp["fc2_b"]
+    return x + h
+
+
+def run_blocks(blocks, x, config, mp_constraint=None, remat=False):
+    """Scan the stacked blocks: one compiled block body, L iterations."""
+    body = block_forward
+    if remat:
+        # config AND mp_constraint are static so sharding constraints survive remat
+        body = jax.checkpoint(block_forward, static_argnums=(2, 3))
+
+    def step(carry, bp):
+        out = body(bp, carry, config, mp_constraint)
+        return out, None
+
+    out, _ = jax.lax.scan(step, x, blocks)
+    return out
+
+
+def forward(params, tokens, config: GPTConfig, mp_constraint=None, remat=False):
+    """tokens [B, S] int32 -> logits [B, S, V]."""
+    x = jnp.take(params["wte"], tokens, axis=0)
+    if not config.use_rope:
+        S = tokens.shape[1]
+        x = x + params["wpe"][:S]
+    if mp_constraint:
+        x = mp_constraint(x, "act")
+    x = run_blocks(params["blocks"], x, config, mp_constraint, remat=remat)
+    x = _norm(x, params["lnf_w"], params["lnf_b"], config)
+    head = params["wte"].T if config.tie_word_embeddings else params["lm_head"]
+    logits = jnp.matmul(x, head)
+    return logits
+
+
+def loss_fn(params, tokens, labels, config: GPTConfig, mp_constraint=None,
+            remat=False):
+    """Causal LM loss; labels [B, S] with -100 = ignore."""
+    logits = forward(params, tokens, config, mp_constraint, remat=remat)
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    safe = jnp.where(labels < 0, 0, labels)
+    picked = jnp.take_along_axis(lp, safe[..., None], axis=-1)[..., 0]
+    mask = (labels >= 0).astype(jnp.float32)
+    return -jnp.sum(picked * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def count_params(params):
+    return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(params))
+
+
+# ---------------------------------------------------------------------------
+# Layer wrapper (eager paddle-style API over the same functional core)
+# ---------------------------------------------------------------------------
+
+from ..core.tensor import Tensor, apply  # noqa: E402
+from ..nn.layer.layers import Layer  # noqa: E402
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, config: GPTConfig = None, **kwargs):
+        super().__init__()
+        self.config = config or GPTConfig(**kwargs)
+        from ..core import generator as _gen
+        raw = init_params(self.config, _gen.next_key())
+        from ..core.tensor import Parameter
+        self._param_tree = jax.tree_util.tree_map(Parameter, raw)
+        # register leaves so Layer machinery (state_dict, optimizers) sees them
+        flat, self._treedef = jax.tree_util.tree_flatten(self._param_tree)
+        for i, p in enumerate(flat):
+            self.add_parameter(f"p{i}", p)
+        self._flat_params = flat
+
+    def forward(self, input_ids, labels=None):
+        # run via apply so the tape records one whole-model node
+        datas = [p for p in self._flat_params]
+        tokens = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
+        cfg = self.config
+        if labels is not None:
+            lab = labels._data if isinstance(labels, Tensor) else jnp.asarray(labels)
+
+            def g(*leafs):
+                tree = jax.tree_util.tree_unflatten(self._treedef, list(leafs))
+                return loss_fn(tree, tokens, lab, cfg)
+            return apply("gpt_loss", g, *datas)
+
+        def h(*leafs):
+            tree = jax.tree_util.tree_unflatten(self._treedef, list(leafs))
+            return forward(tree, tokens, cfg)
+        return apply("gpt_forward", h, *datas)
+
+    def params_pytree(self):
+        """Raw jnp pytree view (shared buffers) for the compiled trainer."""
+        return jax.tree_util.tree_unflatten(
+            self._treedef, [p._data for p in self._flat_params])
+
+    def load_pytree(self, tree):
+        flat, _ = jax.tree_util.tree_flatten(tree)
+        for p, d in zip(self._flat_params, flat):
+            p._data = d

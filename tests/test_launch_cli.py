@@ -1,0 +1,39 @@
+"""Launch CLI tests (reference: `test/legacy_test/test_launch_coverage.py` pattern —
+spawn local trainers with injected cluster env)."""
+import os
+import subprocess
+import sys
+
+
+def test_launch_sets_cluster_env(tmp_path):
+    script = tmp_path / "train.py"
+    script.write_text(
+        "import os\n"
+        "print('RANK=' + os.environ['PADDLE_TRAINER_ID'],"
+        " 'WORLD=' + os.environ['PADDLE_TRAINERS_NUM'])\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__))) \
+        + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", str(tmp_path / "log"), str(script)],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    logs = sorted((tmp_path / "log").glob("workerlog.*"))
+    assert len(logs) == 2
+    contents = "".join(p.read_text() for p in logs)
+    assert "RANK=0 WORLD=2" in contents
+    assert "RANK=1 WORLD=2" in contents
+
+
+def test_launch_propagates_failure(tmp_path):
+    script = tmp_path / "fail.py"
+    script.write_text("import sys; sys.exit(7)\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__))) \
+        + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "1", "--log_dir", str(tmp_path / "log"), str(script)],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 7
