@@ -44,6 +44,16 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        # per-optimizer UNSCALED state (reference OptimizerState): a scaler may
+        # serve several optimizers (e.g. GAN G/D) with independent unscale status
+        unscaled = getattr(self, "_unscaled_opts", None)
+        if unscaled is None:
+            unscaled = self._unscaled_opts = set()
+        if id(optimizer) in unscaled:
+            # unscaling twice before step() would silently shrink the update
+            raise RuntimeError(
+                "unscale_() has already been called on this optimizer since the "
+                "last step()")
         inv = 1.0 / self._scale
         found = False
         for p in optimizer._parameter_list or []:
@@ -53,17 +63,19 @@ class GradScaler:
             found = found or bool(jnp.any(~jnp.isfinite(g)))
             p.grad._data = g.astype(p.grad._data.dtype)
         self._found_inf = found
+        unscaled.add(id(optimizer))
 
     @no_grad()
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
             return
-        if not getattr(self, "_unscaled", False):
+        unscaled = getattr(self, "_unscaled_opts", None) or set()
+        if id(optimizer) not in unscaled:
             self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
-        self._unscaled = False
+        self._unscaled_opts.discard(id(optimizer))
 
     def update(self):
         if not self._enable or not self._dynamic:
@@ -82,7 +94,13 @@ class GradScaler:
                 self._good_steps = 0
 
     def minimize(self, optimizer, loss):
-        loss.backward()
+        # reference pattern is `scaled.backward(); scaler.minimize(opt, scaled)` —
+        # minimize must reuse existing .grad, only running backward if it hasn't
+        # already run on `loss` (tracked directly, robust to retain_graph=True)
+        node = getattr(loss, "_grad_node", None)
+        if node is not None and node.vjp_fn is not None \
+                and not getattr(loss, "_backward_ran", False):
+            loss.backward()
         self.step(optimizer)
         self.update()
 

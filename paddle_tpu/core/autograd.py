@@ -82,18 +82,26 @@ class GradNode:
     """
 
     __slots__ = ("name", "vjp_fn", "inputs", "n_outputs", "out_specs", "out_refs",
-                 "id", "__weakref__")
+                 "jfn", "in_datas", "out_tuple", "id", "__weakref__")
 
     _counter = 0
 
     def __init__(self, name: str, vjp_fn: Callable, inputs: Sequence[Any], n_outputs: int,
-                 out_specs=None):
+                 out_specs=None, jfn=None, in_datas=None, out_tuple=None):
         self.name = name
         self.vjp_fn = vjp_fn
         self.inputs = list(inputs)  # strong refs (TensorWrapper parity)
         self.n_outputs = n_outputs
         self.out_specs = out_specs  # [(shape, dtype)] per output, for zero-filling
         self.out_refs = None  # {out_index: [weakref(Tensor)]} for hooks/retain_grads
+        # jfn: the forward jnp function; kept so create_graph=True can re-linearize
+        # the pullback as a *recorded* op (double backward). in_datas: the original
+        # primal arrays for non-Tensor input slots.
+        self.jfn = jfn
+        self.in_datas = in_datas
+        # whether jfn's output is a tuple/list (pytree structure for the pullback);
+        # None = infer from n_outputs (legacy nodes)
+        self.out_tuple = out_tuple
         GradNode._counter += 1
         self.id = GradNode._counter
 
@@ -129,6 +137,10 @@ def run_backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
     """Full backward from seeds, accumulating into leaf `.grad` (`RunBackward` parity)."""
     _engine(tensors, grad_tensors, retain_graph, inputs=None, create_graph=False,
             allow_unused=True)
+    for t in _as_list(tensors):
+        # minimize() consults this: with retain_graph=True the tape stays live, so
+        # vjp_fn liveness alone can't tell whether backward already ran
+        t._backward_ran = True
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,
@@ -143,6 +155,74 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
                    create_graph=create_graph, allow_unused=allow_unused)
 
 
+def _replay_pullback(node, bufs):
+    """create_graph=True path: recompute this node's vjp as a *recorded* tape op.
+
+    The stored raw pullback closes over the primals as constants, so differentiating
+    through it alone would drop the d(vjp)/d(primal) term (e.g. grad-of-grad of x**3
+    would come out zero).  Instead re-linearize `node.jfn` at the current primals
+    inside a fresh `apply()` so both the cotangents AND the primal inputs are
+    connected for higher-order backward.  Reference capability: higher-order AD via
+    composite grad rules (`fluid/prim/api/composite_backward/`).
+    """
+    from .tensor import Tensor, apply
+
+    if node.jfn is None:
+        raise NotImplementedError(
+            f"create_graph=True through '{node.name}' is not supported: this node "
+            "records no replayable forward function")
+
+    n_in = len(node.inputs)
+    float_outs = [i for i in range(node.n_outputs)
+                  if _is_float_dtype(jnp.dtype(node.out_specs[i][1]))]
+    # input slots whose primal is inexact — only these have non-float0 grads
+    prim_tensors = []
+    for k, inp in enumerate(node.inputs):
+        if isinstance(inp, Tensor):
+            prim_tensors.append(inp)
+        else:
+            prim_tensors.append(Tensor(node.in_datas[k], stop_gradient=True))
+    float_ins = [k for k in range(n_in)
+                 if _is_float_dtype(prim_tensors[k]._data.dtype)]
+
+    cot_tensors = []
+    for i in float_outs:
+        c = bufs.get(i)
+        if c is None:
+            shape, dt = node.out_specs[i]
+            c = Tensor(jnp.zeros(shape, dt), stop_gradient=True)
+        elif not isinstance(c, Tensor):
+            c = Tensor(c, stop_gradient=True)
+        cot_tensors.append(c)
+
+    jfn, n_outs, out_specs = node.jfn, node.n_outputs, node.out_specs
+    out_tuple = node.out_tuple if node.out_tuple is not None else n_outs > 1
+    float_out_set = set(float_outs)
+
+    def replay(*flat):
+        prim = flat[:n_in]
+        cotd = flat[n_in:]
+        _, pull = jax.vjp(jfn, *prim)
+        cots, j = [], 0
+        for i in range(n_outs):
+            if i in float_out_set:
+                cots.append(cotd[j])
+                j += 1
+            else:
+                shape, dt = out_specs[i]
+                cots.append(np.zeros(shape, dtype=jax.dtypes.float0))
+        grads = pull(tuple(cots) if out_tuple else cots[0])
+        return tuple(grads[k] for k in float_ins)
+
+    outs = apply(f"grad_{node.name}", replay, *prim_tensors, *cot_tensors)
+    if not isinstance(outs, (tuple, list)):
+        outs = (outs,)
+    in_cots = [None] * n_in
+    for j, k in enumerate(float_ins):
+        in_cots[k] = outs[j]
+    return in_cots
+
+
 def _engine(tensors, grad_tensors, retain_graph, inputs, create_graph, allow_unused):
     from .tensor import Tensor  # cycle: tensor builds nodes, engine consumes them
 
@@ -151,6 +231,20 @@ def _engine(tensors, grad_tensors, retain_graph, inputs, create_graph, allow_unu
     grad_tensors = _as_list(grad_tensors) or [None] * len(tensors)
     if len(grad_tensors) != len(tensors):
         raise ValueError("grad_tensors length must match tensors")
+
+    if create_graph:
+        # the backward computation itself must be recorded: cotangents flow through
+        # the engine as Tensors and every accumulation/vjp is a tape op
+        with enable_grad():
+            return _engine_impl(tensors, grad_tensors, retain_graph, inputs,
+                                True, allow_unused, partial)
+    return _engine_impl(tensors, grad_tensors, retain_graph, inputs, False,
+                        allow_unused, partial)
+
+
+def _engine_impl(tensors, grad_tensors, retain_graph, inputs, create_graph,
+                 allow_unused, partial):
+    from .tensor import Tensor
 
     # pending[node] = {out_index: accumulated cotangent jnp array}
     pending: Dict[GradNode, Dict[int, Any]] = {}
@@ -191,6 +285,13 @@ def _engine(tensors, grad_tensors, retain_graph, inputs, create_graph, allow_unu
                     "grad can be implicitly created only for scalar outputs; got shape "
                     f"{tuple(t._data.shape)}")
             gdata = jnp.ones(t._data.shape, t._data.dtype)
+            if create_graph:
+                gdata = Tensor(gdata, stop_gradient=True)
+        elif create_graph:
+            # keep the seed as a live Tensor: a grad_outputs that itself requires
+            # grad must stay connected for third-order chains
+            gdata = g if isinstance(g, Tensor) else Tensor(jnp.asarray(g),
+                                                           stop_gradient=True)
         else:
             gdata = g._data if isinstance(g, Tensor) else jnp.asarray(g)
         node = t._grad_node
@@ -233,16 +334,22 @@ def _engine(tensors, grad_tensors, retain_graph, inputs, create_graph, allow_unu
                     if t is None:
                         continue
                     for hook in t._backward_hooks:
-                        res = hook(Tensor(c, stop_gradient=True))
+                        ct = c if isinstance(c, Tensor) else Tensor(c, stop_gradient=True)
+                        res = hook(ct)
                         if res is not None:
-                            c = res._data if isinstance(res, Tensor) else jnp.asarray(res)
+                            if create_graph:
+                                c = res if isinstance(res, Tensor) else \
+                                    Tensor(jnp.asarray(res), stop_gradient=True)
+                            else:
+                                c = res._data if isinstance(res, Tensor) else jnp.asarray(res)
                     if getattr(t, "_retain_grad", False):
+                        craw = c._data if isinstance(c, Tensor) else c
                         if t.grad is None:
-                            g = Tensor(c, stop_gradient=True)
+                            g = Tensor(craw, stop_gradient=True)
                             g.persistable = True
                             t.grad = g
                         else:
-                            t.grad._data = t.grad._data + c
+                            t.grad._data = t.grad._data + craw
                 bufs[i] = c
         if bufs:
             # capture cotangents for requested intermediates produced by this node
@@ -256,31 +363,44 @@ def _engine(tensors, grad_tensors, retain_graph, inputs, create_graph, allow_unu
                 raise RuntimeError(
                     f"Trying to run backward through {node.name} a second time. Set "
                     "retain_graph=True on the first backward if you need this.")
-            cots = []
-            for i in range(node.n_outputs):
-                c = bufs.get(i)
-                if c is None:
-                    shape, dt = node.out_specs[i]
-                    if _is_float_dtype(jnp.dtype(dt)):
-                        c = jnp.zeros(shape, dt)
-                    else:
-                        # integer/bool outputs (e.g. topk indices): jax.vjp expects
-                        # float0 cotangents, not integer zeros
-                        c = np.zeros(shape, dtype=jax.dtypes.float0)
-                cots.append(c)
-            cot_arg = tuple(cots) if node.n_outputs > 1 else cots[0]
-            with set_grad_enabled(create_graph):
-                in_cots = node.vjp_fn(cot_arg)
+            if create_graph:
+                in_cots = _replay_pullback(node, bufs)
+            else:
+                cots = []
+                for i in range(node.n_outputs):
+                    c = bufs.get(i)
+                    if c is None:
+                        shape, dt = node.out_specs[i]
+                        if _is_float_dtype(jnp.dtype(dt)):
+                            c = jnp.zeros(shape, dt)
+                        else:
+                            # integer/bool outputs (e.g. topk indices): jax.vjp
+                            # expects float0 cotangents, not integer zeros
+                            c = np.zeros(shape, dtype=jax.dtypes.float0)
+                    cots.append(c)
+                as_tuple = node.out_tuple if node.out_tuple is not None \
+                    else node.n_outputs > 1
+                cot_arg = tuple(cots) if as_tuple else cots[0]
+                with set_grad_enabled(False):
+                    in_cots = node.vjp_fn(cot_arg)
         if not retain_graph and node.vjp_fn is not None:
+            # release saved residuals; jfn/in_datas too, else the forward closure
+            # and primal arrays outlive backward (create_graph implies
+            # retain_graph, so the replay path never reads them from a freed node)
             node.vjp_fn = None
+            node.jfn = None
+            node.in_datas = None
         for k, inp in enumerate(node.inputs):
             if not isinstance(inp, Tensor):
                 continue
             ic = None
             if in_cots is not None:
                 ic = in_cots[k]
-                if ic is not None and not _is_float_dtype(jnp.asarray(ic).dtype):
-                    ic = None  # int/bool primal: float0 cotangent, nothing to propagate
+                if ic is not None:
+                    dt = ic._data.dtype if isinstance(ic, Tensor) else \
+                        jnp.asarray(ic).dtype
+                    if not _is_float_dtype(dt):
+                        ic = None  # int/bool primal: float0 cotangent, nothing to propagate
             nxt = inp._grad_node
             if nxt is not None:
                 if ic is not None:
@@ -302,6 +422,8 @@ def _engine(tensors, grad_tensors, retain_graph, inputs, create_graph, allow_unu
                     "one of the input tensors was not used in the graph; set "
                     "allow_unused=True to return None for it")
             out.append(None)
+        elif isinstance(g, Tensor):
+            out.append(g)  # create_graph path: already a live tape Tensor
         else:
             out.append(Tensor(g, stop_gradient=not create_graph))
     return out

@@ -493,7 +493,8 @@ def apply(name: str, jfn: Callable, *inputs, n_outputs: Optional[int] = None) ->
     multi = isinstance(outs, (tuple, list))
     out_list = list(outs) if multi else [outs]
     specs = [(o.shape, o.dtype) for o in out_list]
-    node = _ag.GradNode(name, vjp_fn, tensor_inputs, len(out_list), specs)
+    node = _ag.GradNode(name, vjp_fn, tensor_inputs, len(out_list), specs,
+                        jfn=jfn, in_datas=datas, out_tuple=multi)
     return _wrap_outputs(name, outs, node=node)
 
 

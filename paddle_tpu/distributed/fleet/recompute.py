@@ -15,12 +15,62 @@ from ...core import generator as _gen
 from ...core.tensor import Tensor
 
 
+def _collect_params(function, tensor_args):
+    """Trainable Tensors reachable from `function` itself: a Layer's parameters(), a
+    bound method's owner, or Tensors/Layers captured in a plain function's closure.
+    These are vjp primals alongside the explicit tensor args — otherwise activation
+    checkpointing silently stops training the wrapped layers."""
+    seen = {id(t) for t in tensor_args}
+    found = []
+
+    def add(t):
+        if isinstance(t, Tensor) and id(t) not in seen:
+            seen.add(id(t))
+            found.append(t)
+
+    def scan(obj, depth=0):
+        if isinstance(obj, Tensor):
+            add(obj)
+            return
+        params = getattr(obj, "parameters", None)
+        if callable(params):
+            try:
+                for p in params():
+                    add(p)
+                return
+            except TypeError:
+                pass
+        # containers of Layers/Tensors (e.g. recompute_sequential closes over a
+        # plain list of layers); bounded depth so arbitrary objects can't recurse
+        if depth < 3:
+            if isinstance(obj, (list, tuple, set)):
+                for v in obj:
+                    scan(v, depth + 1)
+            elif isinstance(obj, dict):
+                for v in obj.values():
+                    scan(v, depth + 1)
+
+    scan(function)
+    owner = getattr(function, "__self__", None)
+    if owner is not None:
+        scan(owner)
+    for cell in getattr(function, "__closure__", None) or ():
+        try:
+            scan(cell.cell_contents)
+        except ValueError:
+            continue
+    return [p for p in found
+            if not p.stop_gradient and jnp.issubdtype(p._data.dtype, jnp.inexact)]
+
+
 def recompute(function, *args, **kwargs):
     preserve_rng_state = kwargs.pop("preserve_rng_state", True)
     use_reentrant = kwargs.pop("use_reentrant", True)
 
     tensor_args = [a for a in args if isinstance(a, Tensor)]
-    need_grad = _ag.is_grad_enabled() and any(not t.stop_gradient for t in tensor_args)
+    params = _collect_params(function, tensor_args)
+    need_grad = _ag.is_grad_enabled() and any(
+        not t.stop_gradient for t in tensor_args + params)
 
     rng_key = _gen.default_generator().get_state() if preserve_rng_state else None
 
@@ -44,7 +94,7 @@ def recompute(function, *args, **kwargs):
         if preserve_rng_state:
             saved2 = _gen.default_generator().get_state()
             _gen.default_generator().set_state(rng_key)
-        datas = [t._data for t in tensor_args]
+        datas = [t._data for t in tensor_args] + [p._data for p in params]
 
         def pure(*ds):
             new_args = []
@@ -54,12 +104,21 @@ def recompute(function, *args, **kwargs):
                     new_args.append(Tensor(next(it), stop_gradient=a.stop_gradient))
                 else:
                     new_args.append(a)
-            with _ag.set_grad_enabled(False):
-                if preserve_rng_state:
-                    _gen.default_generator().set_state(rng_key)
-                o = function(*new_args, **kwargs)
-            o_list = [o] if not isinstance(o, (tuple, list)) else list(o)
-            return tuple(t._data for t in o_list if isinstance(t, Tensor))
+            # params live inside `function`; substitute their data so jax.vjp sees
+            # them as primals, restoring the originals after the re-trace
+            originals = [p._data for p in params]
+            try:
+                for p in params:
+                    p._data = next(it)
+                with _ag.set_grad_enabled(False):
+                    if preserve_rng_state:
+                        _gen.default_generator().set_state(rng_key)
+                    o = function(*new_args, **kwargs)
+                o_list = [o] if not isinstance(o, (tuple, list)) else list(o)
+                return tuple(t._data for t in o_list if isinstance(t, Tensor))
+            finally:
+                for p, od in zip(params, originals):
+                    p._data = od
 
         _, pull = jax.vjp(pure, *datas)
         grads = pull(tuple(cots))
@@ -69,11 +128,14 @@ def recompute(function, *args, **kwargs):
         gi = iter(grads)
         for a in args:
             res.append(next(gi) if isinstance(a, Tensor) else None)
+        for _p in params:
+            res.append(next(gi))
         return tuple(res)
 
     specs = [(tuple(t._data.shape), t._data.dtype) for t in out_list
              if isinstance(t, Tensor)]
-    node = _ag.GradNode("recompute", vjp_fn, list(args),
+    # params are node inputs so the engine routes their cotangents to leaf .grad
+    node = _ag.GradNode("recompute", vjp_fn, list(args) + params,
                         len([t for t in out_list if isinstance(t, Tensor)]), specs)
     idx = 0
     for t in out_list:

@@ -16,9 +16,10 @@ from ..core.tensor import Tensor
 
 
 def _pack(obj):
+    # Tensors serialize as plain ndarrays (the reference's _build_saved_state_dict
+    # layout) so checkpoints interoperate with reference paddle.load both ways.
     if isinstance(obj, Tensor):
-        return {"__ptensor__": True, "data": np.asarray(obj._data),
-                "stop_gradient": obj.stop_gradient, "name": obj.name}
+        return np.asarray(obj._data)
     if isinstance(obj, dict):
         return {k: _pack(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
@@ -28,8 +29,14 @@ def _pack(obj):
 
 
 def _unpack(obj, return_numpy=False):
+    if isinstance(obj, np.ndarray):
+        if return_numpy:
+            return obj
+        t = Tensor(obj, stop_gradient=True)
+        t.persistable = True
+        return t
     if isinstance(obj, dict):
-        if obj.get("__ptensor__"):
+        if obj.get("__ptensor__"):  # legacy round-1 marker format
             if return_numpy:
                 return obj["data"]
             t = Tensor(obj["data"], stop_gradient=obj.get("stop_gradient", True),

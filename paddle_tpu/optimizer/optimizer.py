@@ -124,7 +124,13 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
-        loss.backward()
+        # skip backward when an explicit loss.backward() already ran (directly
+        # tracked, so retain_graph=True doesn't double-accumulate grads) —
+        # reference minimize only collects existing grads in that pattern
+        node = getattr(loss, "_grad_node", None)
+        if node is not None and node.vjp_fn is not None \
+                and not getattr(loss, "_backward_ran", False):
+            loss.backward()
         self.step()
         return None, None
 

@@ -108,3 +108,150 @@ def test_lbfgs_converges_on_quadratic():
     for _ in range(20):
         loss = opt.step(closure)
     assert float(loss.numpy()) < 1e-3
+
+
+# ---- round-2 advisor findings ----
+
+def test_recompute_trains_wrapped_layer_params():
+    # advisor(high): recompute() must differentiate layer params, not just args
+    from paddle_tpu.distributed.fleet.recompute import recompute
+    lin = nn.Linear(4, 4)
+    x = t(np.random.RandomState(0).randn(2, 4))
+    out = recompute(lin, x)
+    out.sum().backward()
+    assert lin.weight.grad is not None and lin.bias.grad is not None
+    assert x.grad is not None
+    # parity with plain forward
+    lin2 = nn.Linear(4, 4)
+    lin2.set_state_dict(lin.state_dict())
+    x2 = t(x.numpy())
+    lin2(x2).sum().backward()
+    np.testing.assert_allclose(lin.weight.grad.numpy(), lin2.weight.grad.numpy(),
+                               rtol=1e-5)
+
+
+def test_recompute_closure_function_params():
+    from paddle_tpu.distributed.fleet.recompute import recompute
+    lin = nn.Linear(3, 3)
+
+    def fn(x):
+        return paddle.nn.functional.relu(lin(x))
+
+    x = t(np.random.RandomState(1).randn(2, 3))
+    recompute(fn, x).sum().backward()
+    assert lin.weight.grad is not None
+
+
+def test_grad_scaler_unscale_then_step_not_double_unscaled():
+    # advisor(high): unscale_ + clip + step must not unscale twice
+    p = t([1.0])
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+    loss = (p * 2.0).sum()
+    scaler.scale(loss).backward()
+    scaler.unscale_(opt)
+    np.testing.assert_allclose(p.grad.numpy(), [2.0], rtol=1e-6)
+    scaler.step(opt)
+    # update must be grad * lr = 2.0, not 2.0/1024
+    np.testing.assert_allclose(p.numpy(), [-1.0], rtol=1e-5)
+    # calling unscale_ twice before step raises
+    p.clear_grad()
+    loss2 = (p * 2.0).sum()
+    scaler.scale(loss2).backward()
+    scaler.unscale_(opt)
+    with pytest.raises(RuntimeError):
+        scaler.unscale_(opt)
+
+
+def test_grad_scaler_minimize_after_explicit_backward():
+    # advisor(medium): reference pattern scaled.backward(); scaler.minimize(...)
+    p = t([1.0])
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=8.0)
+    scaled = scaler.scale((p * 3.0).sum())
+    scaled.backward()
+    scaler.minimize(opt, scaled)  # must NOT re-run backward
+    np.testing.assert_allclose(p.numpy(), [1.0 - 0.1 * 3.0], rtol=1e-5)
+
+
+def test_optimizer_minimize_after_explicit_backward():
+    p = t([2.0])
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+    loss = (p * p).sum()
+    loss.backward()
+    opt.minimize(loss)  # tape consumed: collect existing grads, no second backward
+    np.testing.assert_allclose(p.numpy(), [2.0 - 0.1 * 4.0], rtol=1e-5)
+
+
+def test_create_graph_second_order():
+    # advisor(medium): double backward — d2/dx2 of x**3 = 6x
+    x = t([2.0, 3.0])
+    y = (x ** 3).sum()
+    (g,) = paddle.grad(y, [x], create_graph=True)
+    np.testing.assert_allclose(g.numpy(), [12.0, 27.0], rtol=1e-5)
+    (g2,) = paddle.grad(g.sum(), [x])
+    np.testing.assert_allclose(g2.numpy(), [12.0, 18.0], rtol=1e-5)
+
+
+def test_create_graph_gradient_penalty():
+    # WGAN-GP style: backward through a grad-norm penalty reaches the leaf
+    x = t([1.0, 2.0])
+    w = t([3.0, 4.0])
+    y = (w * x * x).sum()
+    (gx,) = paddle.grad(y, [x], create_graph=True)  # 2*w*x
+    penalty = (gx * gx).sum()
+    penalty.backward()
+    # d/dw of (2*w*x)^2 = 8*w*x^2
+    np.testing.assert_allclose(w.grad.numpy(), [8.0 * 3.0 * 1.0, 8.0 * 4.0 * 4.0],
+                               rtol=1e-5)
+
+
+def test_save_format_plain_ndarray_interop():
+    # advisor(low): checkpoints are plain {name: ndarray} pickles like the reference
+    import pickle, tempfile, os
+    lin = nn.Linear(2, 2)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "m.pdparams")
+        paddle.save(lin.state_dict(), path)
+        with open(path, "rb") as f:
+            raw = pickle.load(f)
+        assert all(isinstance(v, np.ndarray) for v in raw.values()), raw
+        # reference-produced checkpoints (plain ndarray dicts) load as Tensors
+        loaded = paddle.load(path)
+        assert all(hasattr(v, "numpy") for v in loaded.values())
+        lin.set_state_dict(loaded)
+
+
+def test_recompute_sequential_trains_params():
+    # review: closure holds a plain list of layers — params must still be found
+    from paddle_tpu.distributed.fleet.recompute import recompute_sequential
+    layers = [nn.Linear(3, 3), nn.Linear(3, 3)]
+    x = t(np.random.RandomState(2).randn(2, 3))
+    out = recompute_sequential({"segments": 2}, layers, x)
+    out.sum().backward()
+    for l in layers:
+        assert l.weight.grad is not None
+
+
+def test_grad_scaler_per_optimizer_unscale_state():
+    # review: one scaler, two optimizers (GAN pattern) — independent unscale state
+    pg, pd = t([1.0]), t([1.0])
+    og = paddle.optimizer.SGD(learning_rate=1.0, parameters=[pg])
+    od = paddle.optimizer.SGD(learning_rate=1.0, parameters=[pd])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=64.0)
+    scaler.scale((pg * 2.0).sum() + (pd * 3.0).sum()).backward()
+    scaler.unscale_(og)
+    scaler.unscale_(od)  # must NOT raise: od was never unscaled
+    scaler.step(og)
+    scaler.step(od)
+    np.testing.assert_allclose(pg.numpy(), [-1.0], rtol=1e-5)
+    np.testing.assert_allclose(pd.numpy(), [-2.0], rtol=1e-5)
+
+
+def test_minimize_after_backward_retain_graph_no_double_grad():
+    p = t([2.0])
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+    loss = (p * p).sum()
+    loss.backward(retain_graph=True)
+    opt.minimize(loss)  # tape still live, but backward already ran: no re-run
+    np.testing.assert_allclose(p.numpy(), [2.0 - 0.1 * 4.0], rtol=1e-5)
