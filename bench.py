@@ -55,6 +55,13 @@ def main():
     assert np.isfinite(final)
 
     tokens_per_sec = batch * seq * steps / dt
+    # model FLOPs/token (PaLM-appendix convention): 6*N + causal attention term
+    from paddle_tpu.models.gpt import count_params
+    n_params = count_params(trainer.params)
+    gflop_per_tok = (6 * n_params
+                     + 6 * config.num_layers * seq * config.hidden_size) / 1e9
+    v5e_peak_tf = 197.0  # bf16
+    mfu = tokens_per_sec * gflop_per_tok / 1e3 / v5e_peak_tf
     print(json.dumps({
         "metric": "gpt3_1.3b_pretrain_tokens_per_sec_per_chip" if on_tpu
                   else "gpt_tiny_tokens_per_sec (cpu smoke)",
@@ -62,6 +69,7 @@ def main():
         "unit": "tokens/s/chip",
         "vs_baseline": round(tokens_per_sec / A100_BASELINE_TOKENS_PER_SEC, 3)
                        if on_tpu else 0.0,
+        "mfu_v5e": round(mfu, 3) if on_tpu else None,
     }))
 
 

@@ -41,15 +41,22 @@ class GradScaler:
             return var
         return var * self._scale
 
+    def _opt_state(self, optimizer):
+        # per-optimizer state (reference OptimizerState): a scaler may serve
+        # several optimizers (e.g. GAN G/D) with independent unscale/inf status.
+        # WeakKeyDictionary so dead optimizers don't pin state (and a reused id()
+        # can't alias a new optimizer)
+        import weakref
+        states = getattr(self, "_opt_states", None)
+        if states is None:
+            states = self._opt_states = weakref.WeakKeyDictionary()
+        return states.setdefault(optimizer, {"unscaled": False, "found_inf": False})
+
     def unscale_(self, optimizer):
         if not self._enable:
             return
-        # per-optimizer UNSCALED state (reference OptimizerState): a scaler may
-        # serve several optimizers (e.g. GAN G/D) with independent unscale status
-        unscaled = getattr(self, "_unscaled_opts", None)
-        if unscaled is None:
-            unscaled = self._unscaled_opts = set()
-        if id(optimizer) in unscaled:
+        state = self._opt_state(optimizer)
+        if state["unscaled"]:
             # unscaling twice before step() would silently shrink the update
             raise RuntimeError(
                 "unscale_() has already been called on this optimizer since the "
@@ -62,20 +69,23 @@ class GradScaler:
             g = p.grad._data.astype(jnp.float32) * inv
             found = found or bool(jnp.any(~jnp.isfinite(g)))
             p.grad._data = g.astype(p.grad._data.dtype)
-        self._found_inf = found
-        unscaled.add(id(optimizer))
+        state["unscaled"] = True
+        state["found_inf"] = found
+        # update() adjusts the scale off the union of inf sightings this round
+        self._found_inf = self._found_inf or found
 
     @no_grad()
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
             return
-        unscaled = getattr(self, "_unscaled_opts", None) or set()
-        if id(optimizer) not in unscaled:
+        state = self._opt_state(optimizer)
+        if not state["unscaled"]:
             self.unscale_(optimizer)
-        if not self._found_inf:
+        if not state["found_inf"]:
             optimizer.step()
-        self._unscaled_opts.discard(id(optimizer))
+        state["unscaled"] = False
+        state["found_inf"] = False
 
     def update(self):
         if not self._enable or not self._dynamic:
@@ -92,6 +102,7 @@ class GradScaler:
             if self._good_steps >= self._incr_every:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
+        self._found_inf = False  # fresh round of inf sightings
 
     def minimize(self, optimizer, loss):
         # reference pattern is `scaled.backward(); scaler.minimize(opt, scaled)` —

@@ -175,15 +175,20 @@ def _replay_pullback(node, bufs):
     n_in = len(node.inputs)
     float_outs = [i for i in range(node.n_outputs)
                   if _is_float_dtype(jnp.dtype(node.out_specs[i][1]))]
-    # input slots whose primal is inexact — only these have non-float0 grads
+    # graph edges stay the original tensor objects; values come from the
+    # forward-time primals (in_datas) so an in-place mutation between forward and
+    # this replay can't silently shift the linearization point
     prim_tensors = []
+    overrides = []
     for k, inp in enumerate(node.inputs):
         if isinstance(inp, Tensor):
             prim_tensors.append(inp)
+            overrides.append(node.in_datas[k])
         else:
             prim_tensors.append(Tensor(node.in_datas[k], stop_gradient=True))
+            overrides.append(None)
     float_ins = [k for k in range(n_in)
-                 if _is_float_dtype(prim_tensors[k]._data.dtype)]
+                 if _is_float_dtype(jnp.asarray(node.in_datas[k]).dtype)]
 
     cot_tensors = []
     for i in float_outs:
@@ -214,7 +219,8 @@ def _replay_pullback(node, bufs):
         grads = pull(tuple(cots) if out_tuple else cots[0])
         return tuple(grads[k] for k in float_ins)
 
-    outs = apply(f"grad_{node.name}", replay, *prim_tensors, *cot_tensors)
+    outs = apply(f"grad_{node.name}", replay, *prim_tensors, *cot_tensors,
+                 _data_override=overrides + [None] * len(cot_tensors))
     if not isinstance(outs, (tuple, list)):
         outs = (outs,)
     in_cots = [None] * n_in

@@ -467,17 +467,24 @@ def _set_amp_state(state):
     _amp_state = state
 
 
-def apply(name: str, jfn: Callable, *inputs, n_outputs: Optional[int] = None) -> Any:
+def apply(name: str, jfn: Callable, *inputs, n_outputs: Optional[int] = None,
+          _data_override: Optional[Sequence] = None) -> Any:
     """Single dispatch point for every eager op.
 
     Mirrors the generated ad_func pipeline (`eager_gen.py:214`): AMP cast -> forward ->
     optional NaN check -> GradNode capture via jax.vjp when any input requires grad.
     `jfn` consumes/produces jnp arrays; attrs are closed over by the caller.
+    `_data_override`: per-slot replacement arrays (None = use the input's data) —
+    used by the create_graph replay to linearize at the forward-time primals while
+    keeping the original tensor objects as graph edges.
     """
     if _amp_state is not None and _amp_state.enabled:
         inputs = _amp_state.cast_inputs(name, inputs)
 
     datas = [_to_data(x) for x in inputs]
+    if _data_override is not None:
+        datas = [d if ov is None else ov
+                 for d, ov in zip(datas, _data_override)]
 
     need_grad = _ag.is_grad_enabled() and any(
         isinstance(x, Tensor) and not x.stop_gradient

@@ -156,9 +156,14 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
     out = args[0] if len(args) == 1 else args
 
     def run_segment(start, end, x):
+        # bind ONLY this segment's layers into the closure — _collect_params scans
+        # closure cells, and closing over the full list would drag every layer's
+        # params into every segment's vjp
+        seg_layers = layers[start:end]
+
         def seg_fn(inp):
             h = inp
-            for l in layers[start:end]:
+            for l in seg_layers:
                 h = l(h)
             return h
         return recompute(seg_fn, x)

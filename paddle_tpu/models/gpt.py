@@ -20,6 +20,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name
 
 from ..incubate.kernels.flash_attention import flash_attention_fused
 from ..incubate.kernels.rms_norm import rms_norm_fused
@@ -140,6 +141,12 @@ def block_forward(bp, x, config: GPTConfig, mp_constraint=None):
         sin, cos = _rope_tables(c, S)
         q = apply_rope(q, sin, cos)
         kk = apply_rope(kk, sin, cos)
+    # saved under remat_policy_save_attention: the block replay then DCEs the qkv
+    # matmul + rope (their only consumers' values are saved), keeping replay to
+    # the proj/mlp chain
+    q = checkpoint_name(q, "flash_qkv")
+    kk = checkpoint_name(kk, "flash_qkv")
+    v = checkpoint_name(v, "flash_qkv")
     attn = flash_attention_fused(q, kk, v, causal=True)
     attn = attn.reshape(B, S, D)
     attn = jnp.matmul(attn, bp["proj_w"]) + bp["proj_b"]
@@ -156,10 +163,16 @@ def block_forward(bp, x, config: GPTConfig, mp_constraint=None):
 
 def run_blocks(blocks, x, config, mp_constraint=None, remat=False):
     """Scan the stacked blocks: one compiled block body, L iterations."""
+    from ..incubate.kernels.flash_attention import remat_policy_save_attention
+
     body = block_forward
     if remat:
-        # config AND mp_constraint are static so sharding constraints survive remat
-        body = jax.checkpoint(block_forward, static_argnums=(2, 3))
+        # config AND mp_constraint are static so sharding constraints survive
+        # remat.  The policy saves the flash-attention out/lse residuals, so the
+        # block replay re-runs only the (cheap) matmul chain — attention forward
+        # runs exactly once per step instead of ~3x (round-1 remat tax).
+        body = jax.checkpoint(block_forward, static_argnums=(2, 3),
+                              policy=remat_policy_save_attention())
 
     def step(carry, bp):
         out = body(bp, carry, config, mp_constraint)
@@ -169,8 +182,8 @@ def run_blocks(blocks, x, config, mp_constraint=None, remat=False):
     return out
 
 
-def forward(params, tokens, config: GPTConfig, mp_constraint=None, remat=False):
-    """tokens [B, S] int32 -> logits [B, S, V]."""
+def backbone(params, tokens, config: GPTConfig, mp_constraint=None, remat=False):
+    """Shared trunk: tokens [B, S] -> (pre-head activations [B, S, D], head)."""
     x = jnp.take(params["wte"], tokens, axis=0)
     if not config.use_rope:
         S = tokens.shape[1]
@@ -180,19 +193,51 @@ def forward(params, tokens, config: GPTConfig, mp_constraint=None, remat=False):
     x = run_blocks(params["blocks"], x, config, mp_constraint, remat=remat)
     x = _norm(x, params["lnf_w"], params["lnf_b"], config)
     head = params["wte"].T if config.tie_word_embeddings else params["lm_head"]
-    logits = jnp.matmul(x, head)
-    return logits
+    return x, head
 
 
-def loss_fn(params, tokens, labels, config: GPTConfig, mp_constraint=None,
-            remat=False):
-    """Causal LM loss; labels [B, S] with -100 = ignore."""
-    logits = forward(params, tokens, config, mp_constraint, remat=remat)
+def forward(params, tokens, config: GPTConfig, mp_constraint=None, remat=False):
+    """tokens [B, S] int32 -> logits [B, S, V]."""
+    x, head = backbone(params, tokens, config, mp_constraint, remat)
+    return jnp.matmul(x, head)
+
+
+def _ce_sums(logits, labels):
+    """(-sum log p[label], count) over valid labels (-100 = ignore)."""
     lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     safe = jnp.where(labels < 0, 0, labels)
     picked = jnp.take_along_axis(lp, safe[..., None], axis=-1)[..., 0]
     mask = (labels >= 0).astype(jnp.float32)
-    return -jnp.sum(picked * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return -jnp.sum(picked * mask), jnp.sum(mask)
+
+
+def loss_fn(params, tokens, labels, config: GPTConfig, mp_constraint=None,
+            remat=False, loss_chunk: Optional[int] = 512):
+    """Causal LM loss; labels [B, S] with -100 = ignore.
+
+    loss_chunk: when set, the LM head + softmax run over sequence chunks inside a
+    rematerialized scan, so the [B, S, V] float32 log-probs never materialize —
+    the dominant HBM transient at GPT-3 vocab (V=50k: 3.3 GB at B=8, S=2048).
+    """
+    x, head = backbone(params, tokens, config, mp_constraint, remat)
+    B, S, D = x.shape
+    if not loss_chunk or S % loss_chunk != 0 or S <= loss_chunk:
+        loss_sum, n = _ce_sums(jnp.matmul(x, head), labels)
+        return loss_sum / jnp.maximum(n, 1.0)
+
+    nc = S // loss_chunk
+    xc = jnp.swapaxes(x.reshape(B, nc, loss_chunk, D), 0, 1)       # [nc,B,c,D]
+    labc = jnp.swapaxes(labels.reshape(B, nc, loss_chunk), 0, 1)
+
+    def body(carry, xl):
+        xx, ll = xl
+        ls, n = _ce_sums(jnp.matmul(xx, head), ll)
+        return (carry[0] + ls, carry[1] + n), None
+
+    # remat the chunk: backward replays the chunk's head matmul instead of saving
+    # per-chunk log-probs (head flops are ~5% of the model; the 3 GB is not)
+    (loss_sum, n), _ = jax.lax.scan(jax.checkpoint(body), (0.0, 0.0), (xc, labc))
+    return loss_sum / jnp.maximum(n, 1.0)
 
 
 def count_params(params):

@@ -255,3 +255,33 @@ def test_minimize_after_backward_retain_graph_no_double_grad():
     loss.backward(retain_graph=True)
     opt.minimize(loss)  # tape still live, but backward already ran: no re-run
     np.testing.assert_allclose(p.numpy(), [2.0 - 0.1 * 4.0], rtol=1e-5)
+
+
+def test_grad_scaler_per_optimizer_found_inf():
+    # review r2: one optimizer sees inf grads, the other finite — inf one must
+    # be skipped, finite one stepped, regardless of unscale_ ordering
+    pg, pd = t([1.0]), t([1.0])
+    og = paddle.optimizer.SGD(learning_rate=1.0, parameters=[pg])
+    od = paddle.optimizer.SGD(learning_rate=1.0, parameters=[pd])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+    scaler.scale((pg * 2.0).sum() + (pd * 3.0).sum()).backward()
+    pg.grad._data = pg.grad._data * np.inf  # poison G's grads
+    scaler.unscale_(og)
+    scaler.unscale_(od)  # must not clear og's inf status
+    scaler.step(og)      # skipped: inf
+    scaler.step(od)      # applied
+    np.testing.assert_allclose(pg.numpy(), [1.0])
+    np.testing.assert_allclose(pd.numpy(), [-2.0], rtol=1e-5)
+
+
+def test_create_graph_replay_uses_forward_time_primals():
+    # review r2: mutating a tensor between forward and create_graph backward must
+    # not shift the linearization point
+    x = t([2.0])
+    w = t([3.0])
+    y = (w * x * x).sum()
+    w._data = w._data * 100.0  # in-place mutation after forward
+    (gx,) = paddle.grad(y, [x], create_graph=True)
+    np.testing.assert_allclose(gx.numpy(), [12.0], rtol=1e-5)  # 2*w_orig*x
+    (gxx,) = paddle.grad(gx.sum(), [x], allow_unused=True)
+    np.testing.assert_allclose(gxx.numpy(), [6.0], rtol=1e-5)  # 2*w_orig
