@@ -12,6 +12,7 @@ grads/params across the group with eager collectives.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from ...core.tensor import Tensor
 from ...nn.layer.layers import Layer
@@ -66,26 +67,43 @@ class DygraphShardingOptimizer:
 
 
 class GroupShardedStage2(Layer):
-    """Grad-sharding wrapper (reference `group_sharded_stage2.py`): grads reduce to
-    their owner rank only."""
+    """Grad-sharding wrapper (reference `group_sharded_stage2.py`): each grad
+    reduces to its OWNER rank only (not all-reduced to every rank), halving grad
+    traffic vs plain DP and leaving non-owners free to drop the buffer."""
 
     def __init__(self, layer, sharding_optimizer, group=None, sync_buffers=False,
                  buffer_max_size=2 ** 23, auto_refresh_trainable=True,
                  device="tpu", dp_group=None):
         super().__init__()
         self._layer = layer
-        self._opts = sharding_optimizer if isinstance(sharding_optimizer, list) \
+        opts = sharding_optimizer if isinstance(sharding_optimizer, list) \
             else [sharding_optimizer]
+        self._opts = opts
         self._group = group
-        world = ParallelEnv().world_size if group is None else group.nranks
+        env = ParallelEnv()
+        self._rank = env.rank if group is None else group.get_group_rank(env.rank)
+        world = env.world_size if group is None else group.nranks
+        self._world = world
+        # owner map from the stage-1 optimizer (round-robin-by-size)
+        owner = {}
+        for o in opts:
+            if isinstance(o, DygraphShardingOptimizer):
+                owner.update(o._owner)
         if world > 1:
             for p in layer.parameters():
                 if p.stop_gradient:
                     continue
+                dst = owner.get(id(p), 0)
 
-                def hook(grad, _p=p):
-                    all_reduce(grad, ReduceOp.SUM, group=group)
-                    return Tensor(grad._data / world, stop_gradient=True)
+                def hook(grad, _dst=dst):
+                    from ..communication.ops import reduce as _reduce
+                    _reduce(grad, _dst, ReduceOp.SUM, group=group)
+                    if self._rank != _dst:
+                        # non-owner: grad is dead weight (owner updates + later
+                        # broadcasts the param) — release it
+                        return Tensor(jnp.zeros((), grad._data.dtype),
+                                      stop_gradient=True)
+                    return Tensor(grad._data / self._world, stop_gradient=True)
                 p.register_hook(hook)
 
     def forward(self, *args, **kwargs):
@@ -101,11 +119,96 @@ class GroupShardedStage2(Layer):
         return self._layer.set_state_dict(sd, *a, **kw)
 
 
-class GroupShardedStage3(GroupShardedStage2):
-    """Param-sharding wrapper (reference `group_sharded_stage3.py`).  Eager TPU keeps
-    full params resident (HBM is the constraint the jit path solves via GSPMD param
-    sharding); grad semantics match stage-2 with owner-sharded optimizer state."""
-    pass
+class GroupShardedStage3(Layer):
+    """Param-sharding wrapper (reference `group_sharded_stage3.py`): each rank
+    stores a flat 1/world slice of every parameter; the full tensor is gathered
+    on demand at forward entry and released (re-sliced) after the step; grads
+    reduce-scatter so each rank keeps only its slice's grad, and optimizer state
+    is built on slices.  world==1 degrades to a plain pass-through."""
+
+    def __init__(self, layer, optimizer, group=None, sync_buffers=False,
+                 device="tpu", segment_size=2 ** 20, pertrain_sync_models=True,
+                 offload=False, sync_comm=False, dp_group=None,
+                 exclude_layer=None):
+        super().__init__()
+        self._layer = layer
+        self._opts = optimizer if isinstance(optimizer, list) else [optimizer]
+        self._group = group
+        env = ParallelEnv()
+        self._rank = env.rank if group is None else group.get_group_rank(env.rank)
+        self._world = env.world_size if group is None else group.nranks
+        self._registry = []  # (param, full_shape, padded_len)
+        if self._world > 1:
+            for p in layer.parameters():
+                if p.stop_gradient:
+                    continue
+                full_shape = tuple(p._data.shape)
+                n = int(np.prod(full_shape)) if full_shape else 1
+                pad = (-n) % self._world
+                self._registry.append((p, full_shape, n + pad))
+                self._reshard_param(p, full_shape, n + pad)
+                p.register_hook(self._make_grad_hook(full_shape, n + pad))
+
+    # ---- shard/gather primitives ----
+    def _reshard_param(self, p, full_shape, padded):
+        chunk = padded // self._world
+        flat = jnp.ravel(p._data)
+        flat = jnp.pad(flat, (0, padded - flat.size))
+        p._data = flat[self._rank * chunk:(self._rank + 1) * chunk]
+
+    def _gather_param(self, p, full_shape, padded):
+        from ..communication.ops import all_gather
+        pieces = []
+        all_gather(pieces, Tensor(p._data, stop_gradient=True), group=self._group)
+        flat = jnp.concatenate([t._data for t in pieces])
+        n = int(np.prod(full_shape)) if full_shape else 1
+        p._data = flat[:n].reshape(full_shape)
+
+    def _make_grad_hook(self, full_shape, padded):
+        def hook(grad):
+            from ..communication.ops import reduce_scatter
+            chunk = padded // self._world
+            flat = jnp.ravel(grad._data)
+            flat = jnp.pad(flat, (0, padded - flat.size)) / self._world
+            parts = [Tensor(flat[r * chunk:(r + 1) * chunk], stop_gradient=True)
+                     for r in range(self._world)]
+            out = Tensor(jnp.zeros((chunk,), flat.dtype), stop_gradient=True)
+            reduce_scatter(out, parts, ReduceOp.SUM, group=self._group)
+            return out
+        return hook
+
+    def forward(self, *args, **kwargs):
+        for p, shape, padded in self._registry:
+            self._gather_param(p, shape, padded)
+        out = self._layer(*args, **kwargs)
+        # full values live on in the autograd closures until backward completes;
+        # the resident storage drops back to the slice immediately
+        for p, shape, padded in self._registry:
+            self._reshard_param(p, shape, padded)
+        return out
+
+    def get_all_parameters(self):
+        """Materialize full parameters on every rank (reference API)."""
+        for p, shape, padded in self._registry:
+            self._gather_param(p, shape, padded)
+
+    def parameters(self, *a, **kw):
+        return self._layer.parameters(*a, **kw)
+
+    def state_dict(self, *a, **kw):
+        if self._world > 1:
+            self.get_all_parameters()
+            sd = self._layer.state_dict(*a, **kw)
+            for p, shape, padded in self._registry:
+                self._reshard_param(p, shape, padded)
+            return sd
+        return self._layer.state_dict(*a, **kw)
+
+    def set_state_dict(self, sd, *a, **kw):
+        res = self._layer.set_state_dict(sd, *a, **kw)
+        for p, shape, padded in self._registry:
+            self._reshard_param(p, shape, padded)
+        return res
 
 
 def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,

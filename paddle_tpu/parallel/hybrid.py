@@ -37,21 +37,33 @@ from ..models import gpt as gpt_mod
 class MeshConfig:
     dp: int = 1
     pp: int = 1
+    sharding: int = 1            # ZeRO axis degree (ref topology.py:61 axis order)
     mp: int = 1
-    sharding_stage: int = 1      # ZeRO stage for optimizer state (0 = off)
+    sharding_stage: int = 1      # ZeRO stage: 1=opt state, 2=+grads, 3=+params
     micro_batches: int = 1       # pipeline microbatches (per global step)
     sequence_parallel: bool = False
     remat: bool = False
 
     @property
     def size(self):
-        return self.dp * self.pp * self.mp
+        return self.dp * self.pp * self.sharding * self.mp
+
+    @property
+    def zero_axis(self):
+        """Axis the optimizer state shards over: the dedicated 'sharding' axis
+        when present, else dp (pure-dp ZeRO-1, the round-1 behavior)."""
+        if self.sharding > 1:
+            return "sharding"
+        return "dp" if self.dp > 1 else None
 
 
 def build_mesh(cfg: MeshConfig, devices=None) -> Mesh:
     devs = np.array(devices if devices is not None else jax.devices()[:cfg.size])
     assert devs.size >= cfg.size, f"need {cfg.size} devices, have {devs.size}"
-    return Mesh(devs[:cfg.size].reshape(cfg.dp, cfg.pp, cfg.mp), ("dp", "pp", "mp"))
+    # axis order mirrors the reference hybrid topology ["data","pipe","sharding",
+    # "model"] (fleet/base/topology.py:61)
+    return Mesh(devs[:cfg.size].reshape(cfg.dp, cfg.pp, cfg.sharding, cfg.mp),
+                ("dp", "pp", "sharding", "mp"))
 
 
 # ---------------------------------------------------------------------------
@@ -74,85 +86,184 @@ def gpt_param_specs(cfg: MeshConfig):
         "blocks": blocks,
         "lnf_w": P(None), "lnf_b": P(None),
     }
+    if cfg.sharding_stage >= 3 and cfg.sharding > 1:
+        # ZeRO-3 / FSDP: params shard over the 'sharding' axis at rest; XLA
+        # inserts the gather at each use site and the reduce-scatter on grads
+        # (ref GroupShardedStage3 gather-on-demand, group_sharded_stage3.py).
+        # Only the transformer blocks (the bulk of the params): fsdp-sharding the
+        # vocab-sharded embedding turns the token lookup into a gather XLA's SPMD
+        # partitioner can't device-group (CHECK crash at dp>1), the standard
+        # exclude-embeddings-from-FSDP caveat.
+        specs["blocks"] = _add_axis_everywhere(blocks, "sharding")
     return specs
 
 
-def _opt_state_spec(param_spec: P, shape, cfg: MeshConfig):
-    """ZeRO-1: additionally shard optimizer moments over dp on the first axis that is
-    unsharded and divisible."""
-    if cfg.sharding_stage < 1 or cfg.dp == 1:
-        return param_spec
-    spec = list(param_spec) + [None] * (len(shape) - len(param_spec))
-    for i, (s, cur) in enumerate(zip(shape, spec)):
-        if cur is None and s % cfg.dp == 0 and s >= cfg.dp:
-            spec[i] = "dp"
+def _add_axis(spec: P, shape, axis_name: str, degree: int) -> P:
+    """Shard `axis_name` onto the first unsharded, divisible dim of `shape`."""
+    flat = [a for e in spec if e is not None
+            for a in (e if isinstance(e, tuple) else (e,))]
+    if axis_name in flat:
+        return spec  # already sharded over this axis (e.g. ZeRO-3 params)
+    spec_l = list(spec) + [None] * (len(shape) - len(spec))
+    for i, (s, cur) in enumerate(zip(shape, spec_l)):
+        if cur is None and s % degree == 0 and s >= degree:
+            spec_l[i] = axis_name
             break
-    return P(*spec)
+    return P(*spec_l)
+
+
+def _add_axis_everywhere(specs, axis_name):
+    """Mark specs for late binding: actual dim choice needs shapes, resolved in
+    the trainer where param shapes are known."""
+    return jax.tree_util.tree_map(lambda sp: ("__add__", axis_name, sp), specs,
+                                  is_leaf=lambda x: isinstance(x, P))
+
+
+def _resolve_spec(marked, shape, cfg: MeshConfig):
+    if isinstance(marked, tuple) and len(marked) == 3 and marked[0] == "__add__":
+        _, axis_name, sp = marked
+        return _add_axis(sp, shape, axis_name, cfg.sharding)
+    return marked
+
+
+def _opt_state_spec(param_spec: P, shape, cfg: MeshConfig):
+    """ZeRO-1: shard optimizer moments over the zero axis on the first dim that is
+    unsharded and divisible (ref DygraphShardingOptimizer owner assignment)."""
+    axis = cfg.zero_axis
+    if cfg.sharding_stage < 1 or axis is None:
+        return param_spec
+    degree = cfg.sharding if axis == "sharding" else cfg.dp
+    return _add_axis(param_spec, shape, axis, degree)
 
 
 # ---------------------------------------------------------------------------
 # pipeline loop (manual over 'pp', GSPMD over dp/mp)
 # ---------------------------------------------------------------------------
 
+def _vp_embed(wte, tokens, mesh, cfg: MeshConfig):
+    """Vocab-parallel embedding (ref VocabParallelEmbedding, mp_layers.py:35):
+    masked local lookup on the mp-sharded table + psum.  Keeps the gather fully
+    local so XLA's SPMD partitioner never sees a vocab-sharded gather (which it
+    CHECK-crashes on at 4 live mesh axes)."""
+    if cfg.mp <= 1:
+        return jnp.take(wte, tokens, axis=0)
+
+    def local(wte_l, tok):
+        r = jax.lax.axis_index("mp")
+        Vl = wte_l.shape[0]
+        ids = tok - r * Vl
+        ok = (ids >= 0) & (ids < Vl)
+        safe = jnp.clip(ids, 0, Vl - 1)
+        e = jnp.take(wte_l, safe, axis=0)
+        e = jnp.where(ok[..., None], e, jnp.zeros((), e.dtype))
+        return jax.lax.psum(e, "mp")
+
+    return jax.shard_map(local, mesh=mesh, axis_names={"mp"},
+                         in_specs=(P("mp", None), P()), out_specs=P())(wte, tokens)
+
+
+def _vp_ce(h, head, labels, mesh, cfg: MeshConfig):
+    """Cross entropy with the vocab dim mp-sharded and (when divisible) the batch
+    dim pp-sharded — every device computes head flops exactly once per token (ref
+    ParallelCrossEntropy, mp_layers.py:524)."""
+    manual = set()
+    if cfg.pp > 1 and h.shape[0] % cfg.pp == 0:
+        manual.add("pp")
+    if cfg.mp > 1:
+        manual.add("mp")
+    if not manual:
+        loss_sum, n = gpt_mod._ce_sums(jnp.matmul(h, head), labels)
+        return loss_sum / jnp.maximum(n, 1.0)
+
+    have_mp = "mp" in manual
+
+    def local(h_l, head_l, lab_l):
+        logits = jnp.matmul(h_l, head_l).astype(jnp.float32)  # [b_l, S, V_l]
+        # max shift is stability-only and cancels out of lse - pick; stop_gradient
+        # also sidesteps pmax's missing differentiation rule
+        mx = jax.lax.stop_gradient(jnp.max(logits, axis=-1))
+        if have_mp:
+            mx = jax.lax.pmax(mx, "mp")
+        se = jnp.sum(jnp.exp(logits - mx[..., None]), axis=-1)
+        if have_mp:
+            se = jax.lax.psum(se, "mp")
+        lse = mx + jnp.log(se)
+        if have_mp:
+            r = jax.lax.axis_index("mp")
+            Vl = head_l.shape[-1]
+            ids = lab_l - r * Vl
+            ok = (ids >= 0) & (ids < Vl)
+            safe = jnp.clip(ids, 0, Vl - 1)
+            pick = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+            pick = jax.lax.psum(jnp.where(ok, pick, 0.0), "mp")
+        else:
+            safe = jnp.where(lab_l < 0, 0, lab_l)
+            pick = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+        mask = (lab_l >= 0).astype(jnp.float32)
+        ls = jnp.sum((lse - pick) * mask)
+        n = jnp.sum(mask)
+        if "pp" in manual:
+            ls = jax.lax.psum(ls, "pp")
+            n = jax.lax.psum(n, "pp")
+        return ls, n
+
+    spec_b = P("pp") if "pp" in manual else P()
+    spec_head = P(None, "mp") if have_mp else P()
+    ls, n = jax.shard_map(local, mesh=mesh, axis_names=manual,
+                          in_specs=(spec_b, spec_head, spec_b),
+                          out_specs=(P(), P()))(h, head, labels)
+    return ls / jnp.maximum(n, 1.0)
+
+
 def _pp_loss(params, tokens, labels, config, cfg: MeshConfig, mesh):
-    """GPipe loss under shard_map over 'pp'.  blocks param leading axis is
-    pp-sharded; embed/head replicated across pp."""
-    assert config.use_rope, "pipeline path requires rope (no wpe broadcast across stages)"
-    assert config.tie_word_embeddings, \
-        "pipeline path computes the head from the tied embedding; untied lm_head " \
-        "across stages is not wired yet"
+    """Pipeline-parallel loss: vocab-parallel embed -> microbatch loop over 'pp'
+    via shard_map+ppermute -> last-stage outputs -> vocab/batch-parallel CE.
+
+    Schedule note (ref 1F1B, pipeline_parallel.py:387): the forward is a GPipe
+    sweep, but under jax.grad XLA reverses the tick scan, so backward ticks run
+    newest-microbatch-first exactly like 1F1B cooldown, and per-tick residency is
+    only the boundary activation stack (per-block internals rematerialize via
+    run_blocks' checkpoint policy) — the 1F1B memory profile without the
+    hand-written send/recv schedule.  The LM head runs once per token, sharded
+    over pp (microbatches) and mp (vocab) — no per-tick head waste."""
     M = cfg.micro_batches
     Ppp = cfg.pp
+    B, S = tokens.shape
+    mb = B // M
+    D = config.hidden_size
 
-    def local_fn(blocks_local, wte, lnf_w, lnf_b, tok_mb, lab_mb):
-        # blocks_local: [L/Ppp, ...]; tok_mb/lab_mb: [M, mb, S]
+    x = _vp_embed(params["wte"], tokens, mesh, cfg)
+    if not config.use_rope:
+        x = x + params["wpe"][:S]
+    xs = x.reshape(M, mb, S, D)
+
+    def local_fn(blocks_local, xs_rep):
         p = jax.lax.axis_index("pp")
         T = M + Ppp - 1
-        mb, S = tok_mb.shape[1], tok_mb.shape[2]
-        D = config.hidden_size
-
-        def embed(t):
-            ids = tok_mb[jnp.clip(t, 0, M - 1)]
-            return jnp.take(wte, ids, axis=0)
 
         def tick(buf, t):
-            inp = jnp.where(p == 0, embed(t), buf)
+            inp = jnp.where(p == 0, xs_rep[jnp.clip(t, 0, M - 1)], buf)
             out = gpt_mod.run_blocks(blocks_local, inp, config, remat=cfg.remat)
             nxt = jax.lax.ppermute(out, "pp",
                                    [(i, (i + 1) % Ppp) for i in range(Ppp)])
-            # last stage finalizes microbatch t-(Ppp-1)
-            midx = jnp.clip(t - (Ppp - 1), 0, M - 1)
-            h = gpt_mod._norm(out, lnf_w, lnf_b, config)
-            logits = jnp.matmul(h, wte.T)
-            lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-            lab = lab_mb[midx]
-            safe = jnp.where(lab < 0, 0, lab)
-            picked = jnp.take_along_axis(lp, safe[..., None], axis=-1)[..., 0]
-            mask = (lab >= 0).astype(jnp.float32)
-            valid = ((p == Ppp - 1) & (t >= Ppp - 1) & (t < M + Ppp - 1)) \
-                .astype(jnp.float32)
-            # accumulate global sums so normalization matches the non-pp loss even
-            # with unevenly masked microbatches
-            return nxt, (-jnp.sum(picked * mask) * valid, jnp.sum(mask) * valid)
+            return nxt, out
 
-        buf0 = jax.lax.pvary(jnp.zeros((mb, S, D), wte.dtype), ("pp",))
-        _, (loss_sums, mask_sums) = jax.lax.scan(tick, buf0, jnp.arange(T))
-        total = jnp.sum(loss_sums) / jnp.maximum(jnp.sum(mask_sums), 1.0)
-        # only the last stage holds the loss; share it
-        return jax.lax.psum(total, "pp")
+        buf0 = jax.lax.pvary(jnp.zeros((mb, S, D), xs_rep.dtype), ("pp",))
+        _, outs = jax.lax.scan(tick, buf0, jnp.arange(T))
+        # ticks Ppp-1 .. T-1 hold finished microbatches 0..M-1 on the LAST stage
+        return outs[Ppp - 1:]
 
-    blocks = params["blocks"]
     f = jax.shard_map(
         local_fn, mesh=mesh, axis_names={"pp"},
-        in_specs=(jax.tree_util.tree_map(lambda _: P("pp"), blocks),
-                  P(), P(), P(), P(), P()),
-        out_specs=P(),
-    )
-    B = tokens.shape[0]
-    mb = B // M
-    tok_mb = tokens.reshape(M, mb, -1)
-    lab_mb = labels.reshape(M, mb, -1)
-    return f(blocks, params["wte"], params["lnf_w"], params["lnf_b"], tok_mb, lab_mb)
+        in_specs=(jax.tree_util.tree_map(lambda _: P("pp"), params["blocks"]),
+                  P()),
+        out_specs=P("pp"))
+    stacked = f(params["blocks"], xs)          # [Ppp*M, mb, S, D]
+    hs = stacked[(Ppp - 1) * M:]               # last stage's [M, mb, S, D]
+    h = gpt_mod._norm(hs.reshape(B, S, D), params["lnf_w"], params["lnf_b"],
+                      config)
+    head = params["wte"].T if config.tie_word_embeddings else params["lm_head"]
+    return _vp_ce(h, head, labels, mesh, cfg)
 
 
 # ---------------------------------------------------------------------------
@@ -180,6 +291,14 @@ class HybridParallelTrainer:
             specs["wpe"] = P(None, None)
         if not config.tie_word_embeddings:
             specs["lm_head"] = P(None, "mp" if mesh_cfg.mp > 1 else None)
+        # late-bind ZeRO-3 param sharding (needs the shapes)
+        shapes = jax.eval_shape(functools.partial(gpt_mod.init_params, config),
+                                jax.random.key(0))
+        is_marked = lambda x: isinstance(x, P) or (
+            isinstance(x, tuple) and len(x) == 3 and x[0] == "__add__")
+        specs = jax.tree_util.tree_map(
+            lambda sp, sh: _resolve_spec(sp, sh.shape, mesh_cfg), specs, shapes,
+            is_leaf=is_marked)
         self.param_specs = specs
         self.param_shardings = jax.tree_util.tree_map(
             lambda s: NamedSharding(self.mesh, s), specs,
@@ -210,10 +329,10 @@ class HybridParallelTrainer:
             return x
         if kind in ("hidden_mp", "ffn_mp"):
             return jax.lax.with_sharding_constraint(
-                x, NamedSharding(self.mesh, P("dp", None, "mp")))
+                x, NamedSharding(self.mesh, P(("dp", "sharding"), None, "mp")))
         if kind == "act" and cfg.sequence_parallel:
             return jax.lax.with_sharding_constraint(
-                x, NamedSharding(self.mesh, P("dp", "mp", None)))
+                x, NamedSharding(self.mesh, P(("dp", "sharding"), "mp", None)))
         return x
 
     def _build_step(self):
@@ -233,6 +352,13 @@ class HybridParallelTrainer:
 
         def step(params, opt_state, tokens, labels):
             loss, grads = jax.value_and_grad(loss_of)(params, tokens, labels)
+            if cfg.sharding_stage >= 2 and cfg.zero_axis is not None:
+                # ZeRO-2: pin grads to the moment layout so XLA reduce-scatters
+                # them over the zero axis instead of all-reducing full grads
+                # (ref GroupShardedStage2 reduce-to-owner)
+                grads = jax.tree_util.tree_map(
+                    lambda g, sh: jax.lax.with_sharding_constraint(g, sh),
+                    grads, self._m_shardings)
             if clip is not None:
                 gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
                                      for g in jax.tree_util.tree_leaves(grads)))
@@ -262,7 +388,11 @@ class HybridParallelTrainer:
                                            is_leaf=lambda x: isinstance(x, tuple))
             return loss, new_params, {"m": new_m, "v": new_v, "step": stepno}
 
-        data_sharding = NamedSharding(self.mesh, P("dp", None))
+        # batch splits over dp AND sharding: the zero group is a data-parallel
+        # group with sharded states (ref: sharding sits between dp and mp in the
+        # hybrid topology)
+        batch_axes = ("dp", "sharding")
+        data_sharding = NamedSharding(self.mesh, P(batch_axes, None))
         opt_sh = {"m": self._m_shardings, "v": self._m_shardings, "step": None}
         # out_shardings pinned so params stay in the param layout across steps (else
         # XLA propagates the ZeRO 'dp' shard from the moments onto updated params and
@@ -273,7 +403,7 @@ class HybridParallelTrainer:
                        out_shardings=(None, self.param_shardings, opt_sh))
 
     def shard_batch(self, tokens, labels):
-        ds = NamedSharding(self.mesh, P("dp", None))
+        ds = NamedSharding(self.mesh, P(("dp", "sharding"), None))
         return (jax.device_put(jnp.asarray(tokens), ds),
                 jax.device_put(jnp.asarray(labels), ds))
 
