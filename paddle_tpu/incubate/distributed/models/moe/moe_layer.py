@@ -17,24 +17,15 @@ from .gate import GShardGate, NaiveGate, SwitchGate
 
 
 def dispatch_and_combine(x, gate_idx, gate_val, experts_fn, num_expert, capacity):
-    """Functional GShard dispatch: x [T, D]; gate_idx [T, k]; gate_val [T, k]."""
-    T, D = x.shape
-    k = gate_idx.shape[1]
-    E, C = num_expert, capacity
+    """Functional GShard dispatch: x [T, D]; gate_idx [T, k]; gate_val [T, k].
 
-    onehot = jax.nn.one_hot(gate_idx.astype(jnp.int32), E, dtype=jnp.float32)  # [T,k,E]
-    # position of each token within its expert queue
-    pos = jnp.cumsum(onehot.reshape(T * k, E), axis=0).reshape(T, k, E) * onehot - 1.0
-    keep = (pos < C) & (onehot > 0)
-    pos = jnp.clip(pos, 0, C - 1).astype(jnp.int32)
-    # combine weights [T, k, E, C]
-    capslot = jax.nn.one_hot(pos, C, dtype=jnp.float32) * keep[..., None]
-    combine = jnp.einsum("tk,tkec->tec", gate_val.astype(jnp.float32), capslot)
-    dispatch = (combine > 0).astype(x.dtype)  # [T, E, C]
-    expert_in = jnp.einsum("tec,td->ecd", dispatch, x)  # [E, C, D]
-    expert_out = experts_fn(expert_in)  # [E, C, D]
-    out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
-    return out
+    Slot-scatter formulation (see `dispatch.py`) — no `[T, k, E, C]` combine
+    tensor is materialized."""
+    from .dispatch import capacity_slots, combine, dispatch
+    slot, keep = capacity_slots(gate_idx.astype(jnp.int32), num_expert, capacity)
+    expert_in = dispatch(x, slot, num_expert, capacity)  # [E, C, D]
+    expert_out = experts_fn(expert_in)                   # [E, C, D]
+    return combine(expert_out, slot, keep, gate_val.astype(jnp.float32))
 
 
 class MoELayer(Layer):
@@ -69,31 +60,20 @@ class MoELayer(Layer):
         return out.reshape(orig_shape)
 
     def _forward_eager(self, x2, gate_idx, gate_val, C):
-        from .....ops.creation import zeros
-        from .....ops.manipulation import concat
+        from .dispatch import capacity_slots, combine as combine_fn, dispatch
         E = self.num_expert
-        T = x2.shape[0]
-        k = gate_idx.shape[1]
+        # routing is integer-valued (non-differentiable): compute slot/keep once
+        # and close over them in both tape ops
+        idx = gate_idx._data if isinstance(gate_idx, Tensor) else jnp.asarray(gate_idx)
+        slot, keep = capacity_slots(idx.astype(jnp.int32), E, C)
 
-        def build_combine(idx, val):
-            onehot = jax.nn.one_hot(idx.astype(jnp.int32), E, dtype=jnp.float32)
-            pos = jnp.cumsum(onehot.reshape(T * k, E), axis=0).reshape(T, k, E) * onehot - 1.0
-            keep = (pos < C) & (onehot > 0)
-            posc = jnp.clip(pos, 0, C - 1).astype(jnp.int32)
-            capslot = jax.nn.one_hot(posc, C, dtype=jnp.float32) * keep[..., None]
-            return jnp.einsum("tk,tkec->tec", val.astype(jnp.float32), capslot)
-
-        combine = apply("moe_combine", build_combine, gate_idx, gate_val)
-        dispatch = apply("moe_dispatch", lambda c: (c > 0).astype(x2._data.dtype),
-                         combine)
-        expert_in = apply("moe_scatter", lambda d, xx: jnp.einsum("tec,td->ecd", d, xx),
-                          dispatch, x2)
+        expert_in = apply("moe_scatter", lambda xx: dispatch(xx, slot, E, C), x2)
         outs = []
         for e, expert in enumerate(self.experts):
             outs.append(expert(expert_in[e]))
         from .....ops.manipulation import stack
         expert_out = stack(outs, axis=0)
-        out = apply("moe_gather",
-                    lambda c, eo: jnp.einsum("tec,ecd->td", c.astype(eo.dtype), eo),
-                    combine, expert_out)
-        return out
+        return apply("moe_gather",
+                     lambda val, eo: combine_fn(eo, slot, keep,
+                                                val.astype(jnp.float32)),
+                     gate_val, expert_out)

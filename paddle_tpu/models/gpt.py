@@ -41,6 +41,12 @@ class GPTConfig:
     initializer_range: float = 0.02
     tie_word_embeddings: bool = True
     dtype: Any = jnp.float32
+    # MoE (ref incubate/distributed/models/moe): >0 replaces the dense FFN with
+    # moe_num_experts capacity-routed experts in every block
+    moe_num_experts: int = 0
+    moe_topk: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @property
     def ffn_size(self):
@@ -62,6 +68,12 @@ def gpt_tiny(seq_len=128):
                      max_seq_len=seq_len)
 
 
+def gpt_moe_tiny(seq_len=128, num_experts=4, capacity_factor=2.0):
+    return GPTConfig(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                     max_seq_len=seq_len, moe_num_experts=num_experts,
+                     moe_capacity_factor=capacity_factor)
+
+
 # ---------------------------------------------------------------------------
 # functional core
 # ---------------------------------------------------------------------------
@@ -79,20 +91,33 @@ def init_params(config: GPTConfig, key) -> Dict[str, Any]:
     ln1_w, ln1_b = norm_pair((L, D))
     ln2_w, ln2_b = norm_pair((L, D))
     lnf_w, lnf_b = norm_pair((D,))
-    params = {
-        "wte": (jax.random.normal(next(k), (V, D)) * std).astype(c.dtype),
-        "blocks": {
-            "ln1_w": ln1_w, "ln1_b": ln1_b,
-            "qkv_w": (jax.random.normal(next(k), (L, D, 3 * D)) * std).astype(c.dtype),
-            "qkv_b": jnp.zeros((L, 3 * D), c.dtype),
-            "proj_w": (jax.random.normal(next(k), (L, D, D)) * proj_std).astype(c.dtype),
-            "proj_b": jnp.zeros((L, D), c.dtype),
-            "ln2_w": ln2_w, "ln2_b": ln2_b,
+    blocks = {
+        "ln1_w": ln1_w, "ln1_b": ln1_b,
+        "qkv_w": (jax.random.normal(next(k), (L, D, 3 * D)) * std).astype(c.dtype),
+        "qkv_b": jnp.zeros((L, 3 * D), c.dtype),
+        "proj_w": (jax.random.normal(next(k), (L, D, D)) * proj_std).astype(c.dtype),
+        "proj_b": jnp.zeros((L, D), c.dtype),
+        "ln2_w": ln2_w, "ln2_b": ln2_b,
+    }
+    if c.moe_num_experts > 0:
+        E = c.moe_num_experts
+        blocks.update({
+            "gate_w": (jax.random.normal(next(k), (L, D, E)) * std).astype(jnp.float32),
+            "exp_fc1_w": (jax.random.normal(next(k), (L, E, D, F)) * std).astype(c.dtype),
+            "exp_fc1_b": jnp.zeros((L, E, F), c.dtype),
+            "exp_fc2_w": (jax.random.normal(next(k), (L, E, F, D)) * proj_std).astype(c.dtype),
+            "exp_fc2_b": jnp.zeros((L, E, D), c.dtype),
+        })
+    else:
+        blocks.update({
             "fc1_w": (jax.random.normal(next(k), (L, D, F)) * std).astype(c.dtype),
             "fc1_b": jnp.zeros((L, F), c.dtype),
             "fc2_w": (jax.random.normal(next(k), (L, F, D)) * proj_std).astype(c.dtype),
             "fc2_b": jnp.zeros((L, D), c.dtype),
-        },
+        })
+    params = {
+        "wte": (jax.random.normal(next(k), (V, D)) * std).astype(c.dtype),
+        "blocks": blocks,
         "lnf_w": lnf_w, "lnf_b": lnf_b,
     }
     if not c.use_rope:
@@ -100,6 +125,13 @@ def init_params(config: GPTConfig, key) -> Dict[str, Any]:
     if not c.tie_word_embeddings:
         params["lm_head"] = (jax.random.normal(next(k), (D, V)) * std).astype(c.dtype)
     return params
+
+
+def pvary_compat(x, axes):
+    """Mark x varying over manual mesh axes (pvary was deprecated for pcast)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axes, to="varying")
+    return jax.lax.pvary(x, axes)
 
 
 def _norm(x, w, b, config):
@@ -119,11 +151,15 @@ def _rope_tables(config, S):
     return jnp.sin(freqs), jnp.cos(freqs)
 
 
-def block_forward(bp, x, config: GPTConfig, mp_constraint=None):
+def block_forward(bp, x, config: GPTConfig, mp_constraint=None, moe_impl=None):
     """One transformer block; bp holds this block's (unstacked) weights.
 
     mp_constraint: optional callable applying sharding constraints on activations
     (set by the hybrid trainer to pin the tensor-parallel layout).
+    moe_impl: optional callable (bp, x2d, config) -> (y2d, aux) overriding the
+    MoE FFN (the hybrid trainer injects the ep-axis all-to-all version).
+
+    Returns (out, aux) where aux is the MoE load-balance loss (0.0 when dense).
     """
     c = config
     B, S, D = x.shape
@@ -153,16 +189,23 @@ def block_forward(bp, x, config: GPTConfig, mp_constraint=None):
     x = x + attn
 
     h = _norm(x, bp["ln2_w"], bp["ln2_b"], c)
+    if c.moe_num_experts > 0:
+        from ..incubate.distributed.models.moe.dispatch import moe_ffn_dense
+        fn = moe_impl or moe_ffn_dense
+        y, aux = fn(bp, h.reshape(B * S, D), c)
+        return x + y.reshape(B, S, D), aux
     h = jnp.matmul(h, bp["fc1_w"]) + bp["fc1_b"]
     if mp_constraint:
         h = mp_constraint(h, "ffn_mp")
     h = jax.nn.gelu(h) if c.activation == "gelu" else jax.nn.silu(h)
     h = jnp.matmul(h, bp["fc2_w"]) + bp["fc2_b"]
-    return x + h
+    return x + h, jnp.zeros((), jnp.float32)
 
 
-def run_blocks(blocks, x, config, mp_constraint=None, remat=False):
-    """Scan the stacked blocks: one compiled block body, L iterations."""
+def run_blocks(blocks, x, config, mp_constraint=None, remat=False, moe_impl=None):
+    """Scan the stacked blocks: one compiled block body, L iterations.
+
+    Returns (out, aux) — aux is the summed MoE load-balance loss over blocks."""
     from ..incubate.kernels.flash_attention import remat_policy_save_attention
 
     body = block_forward
@@ -171,34 +214,43 @@ def run_blocks(blocks, x, config, mp_constraint=None, remat=False):
         # remat.  The policy saves the flash-attention out/lse residuals, so the
         # block replay re-runs only the (cheap) matmul chain — attention forward
         # runs exactly once per step instead of ~3x (round-1 remat tax).
-        body = jax.checkpoint(block_forward, static_argnums=(2, 3),
+        body = jax.checkpoint(block_forward, static_argnums=(2, 3, 4),
                               policy=remat_policy_save_attention())
 
     def step(carry, bp):
-        out = body(bp, carry, config, mp_constraint)
-        return out, None
+        x, aux = carry
+        out, a = body(bp, x, config, mp_constraint, moe_impl)
+        return (out, aux + a), None
 
-    out, _ = jax.lax.scan(step, x, blocks)
-    return out
+    # inside a shard_map (pp loop) x is varying over the manual axes; the aux
+    # carry must carry the same vma type or scan rejects the carry signature
+    aux0 = jnp.zeros((), jnp.float32)
+    vma = getattr(jax.typeof(x), "vma", None) if hasattr(jax, "typeof") else None
+    if vma:
+        aux0 = pvary_compat(aux0, tuple(vma))
+    (out, aux), _ = jax.lax.scan(step, (x, aux0), blocks)
+    return out, aux
 
 
-def backbone(params, tokens, config: GPTConfig, mp_constraint=None, remat=False):
-    """Shared trunk: tokens [B, S] -> (pre-head activations [B, S, D], head)."""
+def backbone(params, tokens, config: GPTConfig, mp_constraint=None, remat=False,
+             moe_impl=None):
+    """Shared trunk: tokens [B, S] -> (activations [B, S, D], head, moe aux)."""
     x = jnp.take(params["wte"], tokens, axis=0)
     if not config.use_rope:
         S = tokens.shape[1]
         x = x + params["wpe"][:S]
     if mp_constraint:
         x = mp_constraint(x, "act")
-    x = run_blocks(params["blocks"], x, config, mp_constraint, remat=remat)
+    x, aux = run_blocks(params["blocks"], x, config, mp_constraint, remat=remat,
+                        moe_impl=moe_impl)
     x = _norm(x, params["lnf_w"], params["lnf_b"], config)
     head = params["wte"].T if config.tie_word_embeddings else params["lm_head"]
-    return x, head
+    return x, head, aux
 
 
 def forward(params, tokens, config: GPTConfig, mp_constraint=None, remat=False):
     """tokens [B, S] int32 -> logits [B, S, V]."""
-    x, head = backbone(params, tokens, config, mp_constraint, remat)
+    x, head, _ = backbone(params, tokens, config, mp_constraint, remat)
     return jnp.matmul(x, head)
 
 
@@ -212,18 +264,19 @@ def _ce_sums(logits, labels):
 
 
 def loss_fn(params, tokens, labels, config: GPTConfig, mp_constraint=None,
-            remat=False, loss_chunk: Optional[int] = 512):
+            remat=False, loss_chunk: Optional[int] = 512, moe_impl=None):
     """Causal LM loss; labels [B, S] with -100 = ignore.
 
     loss_chunk: when set, the LM head + softmax run over sequence chunks inside a
     rematerialized scan, so the [B, S, V] float32 log-probs never materialize —
     the dominant HBM transient at GPT-3 vocab (V=50k: 3.3 GB at B=8, S=2048).
     """
-    x, head = backbone(params, tokens, config, mp_constraint, remat)
+    x, head, aux = backbone(params, tokens, config, mp_constraint, remat, moe_impl)
+    moe_pen = config.moe_aux_weight * aux if config.moe_num_experts > 0 else 0.0
     B, S, D = x.shape
     if not loss_chunk or S % loss_chunk != 0 or S <= loss_chunk:
         loss_sum, n = _ce_sums(jnp.matmul(x, head), labels)
-        return loss_sum / jnp.maximum(n, 1.0)
+        return loss_sum / jnp.maximum(n, 1.0) + moe_pen
 
     nc = S // loss_chunk
     xc = jnp.swapaxes(x.reshape(B, nc, loss_chunk, D), 0, 1)       # [nc,B,c,D]
@@ -237,7 +290,7 @@ def loss_fn(params, tokens, labels, config: GPTConfig, mp_constraint=None,
     # remat the chunk: backward replays the chunk's head matmul instead of saving
     # per-chunk log-probs (head flops are ~5% of the model; the 3 GB is not)
     (loss_sum, n), _ = jax.lax.scan(jax.checkpoint(body), (0.0, 0.0), (xc, labc))
-    return loss_sum / jnp.maximum(n, 1.0)
+    return loss_sum / jnp.maximum(n, 1.0) + moe_pen
 
 
 def count_params(params):

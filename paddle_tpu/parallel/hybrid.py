@@ -39,6 +39,7 @@ class MeshConfig:
     pp: int = 1
     sharding: int = 1            # ZeRO axis degree (ref topology.py:61 axis order)
     mp: int = 1
+    ep: int = 1                  # expert-parallel degree (MoE all-to-all group)
     sharding_stage: int = 1      # ZeRO stage: 1=opt state, 2=+grads, 3=+params
     micro_batches: int = 1       # pipeline microbatches (per global step)
     sequence_parallel: bool = False
@@ -46,7 +47,7 @@ class MeshConfig:
 
     @property
     def size(self):
-        return self.dp * self.pp * self.sharding * self.mp
+        return self.dp * self.pp * self.sharding * self.mp * self.ep
 
     @property
     def zero_axis(self):
@@ -61,26 +62,40 @@ def build_mesh(cfg: MeshConfig, devices=None) -> Mesh:
     devs = np.array(devices if devices is not None else jax.devices()[:cfg.size])
     assert devs.size >= cfg.size, f"need {cfg.size} devices, have {devs.size}"
     # axis order mirrors the reference hybrid topology ["data","pipe","sharding",
-    # "model"] (fleet/base/topology.py:61)
-    return Mesh(devs[:cfg.size].reshape(cfg.dp, cfg.pp, cfg.sharding, cfg.mp),
-                ("dp", "pp", "sharding", "mp"))
+    # "model"] (fleet/base/topology.py:61) with the MoE 'ep' axis innermost so
+    # the dispatch all-to-all rides adjacent ICI links
+    return Mesh(devs[:cfg.size].reshape(cfg.dp, cfg.pp, cfg.sharding, cfg.mp,
+                                        cfg.ep),
+                ("dp", "pp", "sharding", "mp", "ep"))
 
 
 # ---------------------------------------------------------------------------
 # sharding rules for the GPT params pytree (Megatron layout)
 # ---------------------------------------------------------------------------
 
-def gpt_param_specs(cfg: MeshConfig):
+def gpt_param_specs(cfg: MeshConfig, model_config=None):
     pp = "pp" if cfg.pp > 1 else None
     mp = "mp" if cfg.mp > 1 else None
+    ep = "ep" if cfg.ep > 1 else None
     blocks = {
         "ln1_w": P(pp, None), "ln1_b": P(pp, None),
         "qkv_w": P(pp, None, mp), "qkv_b": P(pp, mp),
         "proj_w": P(pp, mp, None), "proj_b": P(pp, None),
         "ln2_w": P(pp, None), "ln2_b": P(pp, None),
-        "fc1_w": P(pp, None, mp), "fc1_b": P(pp, mp),
-        "fc2_w": P(pp, mp, None), "fc2_b": P(pp, None),
     }
+    if model_config is not None and model_config.moe_num_experts > 0:
+        # experts shard over 'ep' on the E dim (ref: experts distributed across
+        # the moe_group ranks, dispatched via global_scatter) — router replicated
+        blocks.update({
+            "gate_w": P(pp, None, None),
+            "exp_fc1_w": P(pp, ep, None, None), "exp_fc1_b": P(pp, ep, None),
+            "exp_fc2_w": P(pp, ep, None, None), "exp_fc2_b": P(pp, ep, None),
+        })
+    else:
+        blocks.update({
+            "fc1_w": P(pp, None, mp), "fc1_b": P(pp, mp),
+            "fc2_w": P(pp, mp, None), "fc2_b": P(pp, None),
+        })
     specs = {
         "wte": P(mp, None),
         "blocks": blocks,
@@ -137,6 +152,67 @@ def _opt_state_spec(param_spec: P, shape, cfg: MeshConfig):
 
 
 # ---------------------------------------------------------------------------
+# expert parallelism: global_scatter/global_gather over the 'ep' axis
+# ---------------------------------------------------------------------------
+
+def _moe_local(bp_local, x_l, config, ep: int):
+    """Per-ep-rank MoE FFN body: the TPU-native global_scatter/global_gather
+    (ref fluid/operators/collective/global_scatter_op.cc).
+
+    Runs INSIDE a manual 'ep' region: x_l [T_l, D] is this rank's token shard and
+    bp_local holds this rank's E/ep experts (gate replicated).  Each rank routes
+    its tokens into per-expert capacity buffers, a tiled `all_to_all` hands every
+    expert its queue slices from all ranks, the batched expert MLP runs on the
+    owner, and the reverse all-to-all returns outputs for the local combine.
+    Returns (y_l, aux_local) — caller aggregates aux over ep.
+    """
+    from ..incubate.distributed.models.moe.dispatch import (
+        capacity_slots, combine, dispatch, expert_ffn, moe_capacity, topk_gating)
+
+    E, k = config.moe_num_experts, config.moe_topk
+    assert E % ep == 0, f"experts {E} must divide over ep={ep}"
+    Tl, D = x_l.shape
+    C = moe_capacity(Tl, k, E, config.moe_capacity_factor)
+    gate_idx, gate_val, aux = topk_gating(jnp.matmul(x_l, bp_local["gate_w"]), k)
+    slot, keep = capacity_slots(gate_idx, E, C)
+    buf = dispatch(x_l, slot, E, C)                       # [E, C, D]
+    if ep > 1:
+        # global_scatter: chunk j (experts j*El..) -> rank j; received chunks
+        # stack along capacity, source-rank-major -> [E/ep, ep*C, D]
+        buf = jax.lax.all_to_all(buf, "ep", split_axis=0, concat_axis=1,
+                                 tiled=True)
+    out = expert_ffn(buf, bp_local["exp_fc1_w"], bp_local["exp_fc1_b"],
+                     bp_local["exp_fc2_w"], bp_local["exp_fc2_b"],
+                     config.activation)
+    if ep > 1:
+        # global_gather: return each rank its C-slice of every expert queue
+        out = jax.lax.all_to_all(out, "ep", split_axis=1, concat_axis=0,
+                                 tiled=True)              # [E, C, D]
+    y = combine(out, slot, keep, gate_val)
+    return y, aux
+
+
+_MOE_EXPERT_KEYS = ("exp_fc1_w", "exp_fc1_b", "exp_fc2_w", "exp_fc2_b")
+
+
+def _moe_ffn_ep(bp, x, config, cfg: MeshConfig, mesh):
+    """GSPMD-path wrapper: shard_map the manual 'ep' MoE body over x [T, D]."""
+
+    def local(gate_w, f1w, f1b, f2w, f2b, x_l):
+        bp_local = {"gate_w": gate_w, "exp_fc1_w": f1w, "exp_fc1_b": f1b,
+                    "exp_fc2_w": f2w, "exp_fc2_b": f2b}
+        y, aux = _moe_local(bp_local, x_l, config, cfg.ep)
+        return y, jax.lax.psum(aux, "ep") / cfg.ep
+
+    return jax.shard_map(
+        local, mesh=mesh, axis_names={"ep"},
+        in_specs=(P(), P("ep"), P("ep"), P("ep"), P("ep"), P("ep")),
+        out_specs=(P("ep"), P()))(
+            bp["gate_w"], bp["exp_fc1_w"], bp["exp_fc1_b"],
+            bp["exp_fc2_w"], bp["exp_fc2_b"], x)
+
+
+# ---------------------------------------------------------------------------
 # pipeline loop (manual over 'pp', GSPMD over dp/mp)
 # ---------------------------------------------------------------------------
 
@@ -167,8 +243,20 @@ def _vp_ce(h, head, labels, mesh, cfg: MeshConfig):
     dim pp-sharded — every device computes head flops exactly once per token (ref
     ParallelCrossEntropy, mp_layers.py:524)."""
     manual = set()
+    batch_axes = ()
     if cfg.pp > 1 and h.shape[0] % cfg.pp == 0:
         manual.add("pp")
+        batch_axes = ("pp",)
+        # with an ep axis live, leaving it auto makes XLA's gather partitioner
+        # CHECK-crash on the label pick; fold it into the manual batch split,
+        # or fall back to the dense CE when the batch doesn't divide
+        if cfg.ep > 1:
+            if h.shape[0] % (cfg.pp * cfg.ep) == 0:
+                manual.add("ep")
+                batch_axes = ("pp", "ep")
+            else:
+                manual.discard("pp")
+                batch_axes = ()
     if cfg.mp > 1:
         manual.add("mp")
     if not manual:
@@ -202,12 +290,12 @@ def _vp_ce(h, head, labels, mesh, cfg: MeshConfig):
         mask = (lab_l >= 0).astype(jnp.float32)
         ls = jnp.sum((lse - pick) * mask)
         n = jnp.sum(mask)
-        if "pp" in manual:
-            ls = jax.lax.psum(ls, "pp")
-            n = jax.lax.psum(n, "pp")
+        if batch_axes:
+            ls = jax.lax.psum(ls, batch_axes)
+            n = jax.lax.psum(n, batch_axes)
         return ls, n
 
-    spec_b = P("pp") if "pp" in manual else P()
+    spec_b = P(batch_axes) if batch_axes else P()
     spec_head = P(None, "mp") if have_mp else P()
     ls, n = jax.shard_map(local, mesh=mesh, axis_names=manual,
                           in_specs=(spec_b, spec_head, spec_b),
@@ -231,6 +319,16 @@ def _pp_loss(params, tokens, labels, config, cfg: MeshConfig, mesh):
     B, S = tokens.shape
     mb = B // M
     D = config.hidden_size
+    # MoE with ep runs in the SAME manual region as pp (shardy requires manual
+    # axes to be declared together rather than nested), so each (pp, ep) rank
+    # routes its microbatch shard and all_to_all's over 'ep' inside the tick
+    moe_manual = config.moe_num_experts > 0 and cfg.ep > 1
+    manual = ("pp", "ep") if moe_manual else ("pp",)
+    if moe_manual:
+        assert mb % cfg.ep == 0, f"microbatch {mb} must divide over ep={cfg.ep}"
+    mb_l = mb // cfg.ep if moe_manual else mb
+    moe_impl = (lambda bpl, xl, c: _moe_local(bpl, xl, c, cfg.ep)) \
+        if moe_manual else None
 
     x = _vp_embed(params["wte"], tokens, mesh, cfg)
     if not config.use_rope:
@@ -241,29 +339,43 @@ def _pp_loss(params, tokens, labels, config, cfg: MeshConfig, mesh):
         p = jax.lax.axis_index("pp")
         T = M + Ppp - 1
 
-        def tick(buf, t):
+        def tick(carry, t):
+            buf, aux_acc = carry
             inp = jnp.where(p == 0, xs_rep[jnp.clip(t, 0, M - 1)], buf)
-            out = gpt_mod.run_blocks(blocks_local, inp, config, remat=cfg.remat)
+            out, aux = gpt_mod.run_blocks(blocks_local, inp, config,
+                                          remat=cfg.remat, moe_impl=moe_impl)
             nxt = jax.lax.ppermute(out, "pp",
                                    [(i, (i + 1) % Ppp) for i in range(Ppp)])
-            return nxt, out
+            # stage p holds real microbatch (t - p) only for p <= t < p + M;
+            # warmup/cooldown ticks run on garbage and must not pollute aux
+            valid = ((t >= p) & (t < p + M)).astype(aux.dtype)
+            return (nxt, aux_acc + aux * valid), out
 
-        buf0 = jax.lax.pvary(jnp.zeros((mb, S, D), xs_rep.dtype), ("pp",))
-        _, outs = jax.lax.scan(tick, buf0, jnp.arange(T))
+        buf0 = gpt_mod.pvary_compat(jnp.zeros((mb_l, S, D), xs_rep.dtype), manual)
+        aux0 = gpt_mod.pvary_compat(jnp.zeros((), jnp.float32), manual)
+        (_, aux_sum), outs = jax.lax.scan(tick, (buf0, aux0), jnp.arange(T))
         # ticks Ppp-1 .. T-1 hold finished microbatches 0..M-1 on the LAST stage
-        return outs[Ppp - 1:]
+        return outs[Ppp - 1:], jax.lax.psum(aux_sum, manual)
 
+    blk_in = {k: (P("pp", "ep") if (moe_manual and k in _MOE_EXPERT_KEYS)
+                  else P("pp"))
+              for k in params["blocks"]}
     f = jax.shard_map(
-        local_fn, mesh=mesh, axis_names={"pp"},
-        in_specs=(jax.tree_util.tree_map(lambda _: P("pp"), params["blocks"]),
-                  P()),
-        out_specs=P("pp"))
-    stacked = f(params["blocks"], xs)          # [Ppp*M, mb, S, D]
+        local_fn, mesh=mesh, axis_names=set(manual),
+        in_specs=(blk_in, P(None, "ep") if moe_manual else P()),
+        out_specs=(P("pp", "ep") if moe_manual else P("pp"), P()))
+    stacked, aux_sum = f(params["blocks"], xs)  # [Ppp*M, mb, S, D]
+    if moe_manual:
+        aux_sum = aux_sum / cfg.ep
     hs = stacked[(Ppp - 1) * M:]               # last stage's [M, mb, S, D]
     h = gpt_mod._norm(hs.reshape(B, S, D), params["lnf_w"], params["lnf_b"],
                       config)
     head = params["wte"].T if config.tie_word_embeddings else params["lm_head"]
-    return _vp_ce(h, head, labels, mesh, cfg)
+    loss = _vp_ce(h, head, labels, mesh, cfg)
+    if config.moe_num_experts > 0:
+        # aux_sum covers all M microbatches; average to match the dense scale
+        loss = loss + config.moe_aux_weight * aux_sum / M
+    return loss
 
 
 # ---------------------------------------------------------------------------
@@ -286,7 +398,7 @@ class HybridParallelTrainer:
         self.clip_norm = grad_clip_norm
         self.moment_dtype = moment_dtype
 
-        specs = gpt_param_specs(mesh_cfg)
+        specs = gpt_param_specs(mesh_cfg, config)
         if not config.use_rope:
             specs["wpe"] = P(None, None)
         if not config.tie_word_embeddings:
@@ -329,10 +441,10 @@ class HybridParallelTrainer:
             return x
         if kind in ("hidden_mp", "ffn_mp"):
             return jax.lax.with_sharding_constraint(
-                x, NamedSharding(self.mesh, P(("dp", "sharding"), None, "mp")))
+                x, NamedSharding(self.mesh, P(("dp", "sharding", "ep"), None, "mp")))
         if kind == "act" and cfg.sequence_parallel:
             return jax.lax.with_sharding_constraint(
-                x, NamedSharding(self.mesh, P(("dp", "sharding"), "mp", None)))
+                x, NamedSharding(self.mesh, P(("dp", "sharding", "ep"), "mp", None)))
         return x
 
     def _build_step(self):
@@ -343,12 +455,16 @@ class HybridParallelTrainer:
         b1, b2 = self.betas
         clip = self.clip_norm
 
+        moe_impl = None
+        if config.moe_num_experts > 0 and cfg.ep > 1:
+            moe_impl = functools.partial(_moe_ffn_ep, cfg=cfg, mesh=mesh)
+
         def loss_of(params, tokens, labels):
             if cfg.pp > 1:
                 return _pp_loss(params, tokens, labels, config, cfg, mesh)
             return gpt_mod.loss_fn(params, tokens, labels, config,
                                    mp_constraint=self._mp_constraint,
-                                   remat=cfg.remat)
+                                   remat=cfg.remat, moe_impl=moe_impl)
 
         def step(params, opt_state, tokens, labels):
             loss, grads = jax.value_and_grad(loss_of)(params, tokens, labels)
@@ -388,10 +504,10 @@ class HybridParallelTrainer:
                                            is_leaf=lambda x: isinstance(x, tuple))
             return loss, new_params, {"m": new_m, "v": new_v, "step": stepno}
 
-        # batch splits over dp AND sharding: the zero group is a data-parallel
-        # group with sharded states (ref: sharding sits between dp and mp in the
-        # hybrid topology)
-        batch_axes = ("dp", "sharding")
+        # batch splits over dp AND sharding AND ep: the zero group is a
+        # data-parallel group with sharded states, and ep ranks each own a batch
+        # shard whose tokens they route (ref: moe_group is a data-parallel group)
+        batch_axes = ("dp", "sharding", "ep")
         data_sharding = NamedSharding(self.mesh, P(batch_axes, None))
         opt_sh = {"m": self._m_shardings, "v": self._m_shardings, "step": None}
         # out_shardings pinned so params stay in the param layout across steps (else
@@ -403,7 +519,7 @@ class HybridParallelTrainer:
                        out_shardings=(None, self.param_shardings, opt_sh))
 
     def shard_batch(self, tokens, labels):
-        ds = NamedSharding(self.mesh, P(("dp", "sharding"), None))
+        ds = NamedSharding(self.mesh, P(("dp", "sharding", "ep"), None))
         return (jax.device_put(jnp.asarray(tokens), ds),
                 jax.device_put(jnp.asarray(labels), ds))
 
