@@ -16,11 +16,16 @@ import numpy as np
 
 
 class Generator:
-    """Stateful RNG built on splitting a jax PRNG key."""
+    """Stateful RNG built on splitting a jax PRNG key.
+
+    The key materializes lazily on first draw: creating it eagerly would
+    initialize the XLA backend at import time, which breaks
+    `jax.distributed.initialize` (must run before any backend use — see
+    distributed/parallel_env.py)."""
 
     def __init__(self, seed: int = 0):
         self._seed = int(seed)
-        self._key = jax.random.key(self._seed)
+        self._key = None
         self._lock = threading.Lock()
 
     def manual_seed(self, seed: int) -> "Generator":
@@ -36,17 +41,22 @@ class Generator:
     def next_key(self):
         """Split and return a fresh subkey (advances state)."""
         with self._lock:
+            if self._key is None:
+                self._key = jax.random.key(self._seed)
             self._key, sub = jax.random.split(self._key)
         return sub
 
     def get_state(self):
-        return self._key
+        with self._lock:
+            if self._key is None:
+                self._key = jax.random.key(self._seed)
+            return self._key
 
     def set_state(self, key):
         self._key = key
 
 
-_default = Generator(np.random.randint(0, 2**31 - 1))
+_default = Generator(int(np.random.randint(0, 2**31 - 1)))
 
 
 def default_generator() -> Generator:

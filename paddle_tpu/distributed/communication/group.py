@@ -126,7 +126,7 @@ def wait(tensor, group=None, use_calc_stream=True):
 
 
 def barrier(group=None):
-    from .all_reduce import all_reduce
+    from .ops import all_reduce
     from ...ops.creation import ones
     t = ones([1], "float32")
     all_reduce(t, group=group)

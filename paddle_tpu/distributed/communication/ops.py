@@ -72,9 +72,26 @@ def _to_global(x_data, group: Group):
     mesh = _group_mesh(group)
     sharding = NamedSharding(mesh, P("x"))
     local_dev = jax.local_devices()[0]
-    local = jax.device_put(x_data[None], local_dev)
+    local = jax.device_put(jnp.asarray(x_data)[None], local_dev)
     shape = (group.nranks,) + tuple(x_data.shape)
     return jax.make_array_from_single_device_arrays(shape, sharding, [local]), mesh
+
+
+_collective_jit_cache = {}
+
+
+def _replicated_jit(key, fn, mesh):
+    """Cached jit of a collective body over `mesh` with a replicated output
+    every process can read locally.  Caching on (key, mesh) keeps eager
+    collectives (e.g. DataParallel's per-param allreduce hooks) from re-tracing
+    a fresh lambda on every call."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    k = (key, mesh)
+    got = _collective_jit_cache.get(k)
+    if got is None:
+        got = jax.jit(fn, out_shardings=NamedSharding(mesh, P()))
+        _collective_jit_cache[k] = got
+    return got
 
 
 def _from_global(garr):
@@ -97,10 +114,8 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
             "paddle_tpu.distributed.launch); inside jit use mesh sharding instead")
     garr, mesh = _to_global(tensor._data, g)
     red = _reduce_fn(op)
-    out = jax.jit(lambda a: red(a, axis=0))(garr)
-    # result is replicated; take local copy
-    tensor._data = np.asarray(out) * 1  # device-local materialization
-    tensor._data = jnp.asarray(tensor._data)
+    fn = _replicated_jit(("reduce", op), lambda a: red(a, axis=0), mesh)
+    tensor._data = jnp.asarray(np.asarray(fn(garr)))
     return _Task([tensor])
 
 
@@ -112,8 +127,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     if not _multiproc():
         raise RuntimeError("eager all_gather needs jax.distributed")
     garr, mesh = _to_global(tensor._data, g)
-    out = jax.jit(lambda a: a)(garr)
-    full = np.asarray(out)
+    full = np.asarray(_replicated_jit("gather", lambda a: a, mesh)(garr))
     for i in range(g.nranks):
         tensor_list.append(Tensor(jnp.asarray(full[i])))
     return _Task(tensor_list)
@@ -248,21 +262,54 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=
     return _Task([out_tensor])
 
 
+def _p2p_pair(tensor, src, dst, group: Group):
+    """Matched-pair p2p (ref `send_v2`/`recv_v2` over NCCL): the two endpoints
+    execute one shared 2-device permute program; only src and dst participate.
+
+    The exchange is a jitted copy over a 2-rank mesh — dst's row of the global
+    array is replaced by src's — so, like the reference, a send with no matching
+    recv (or mismatched shapes/dtypes) blocks."""
+    pair = sorted({src, dst})
+    me = group.ranks[group.rank]
+    sub = Group(pair.index(me), -1, pair)
+    garr, mesh = _to_global(tensor._data, sub)
+    si, di = sub.get_group_rank(src), sub.get_group_rank(dst)
+    perm = np.arange(sub.nranks)
+    perm[di] = si
+    fn = _replicated_jit("p2p", lambda a, p: a[p], mesh)
+    return np.asarray(fn(garr, jnp.asarray(perm)))[di]
+
+
 def send(tensor, dst=0, group=None, sync_op=True):
+    """Send to global rank dst.  Must be paired with a `recv` on dst (matched
+    pairs, same shape/dtype — reference `send_v2` semantics)."""
     g = _group(group)
     if g.nranks <= 1:
         return _Task([])
-    raise NotImplementedError(
-        "eager p2p send: TPU p2p lives inside compiled programs (ppermute under "
-        "shard_map — see paddle_tpu.distributed.fleet pipeline_parallel); the eager "
-        "path intentionally has no NCCL-style stream send")
+    if not _multiproc():
+        raise RuntimeError(
+            "eager p2p send across ranks needs jax.distributed (launch via "
+            "paddle_tpu.distributed.launch); inside jit use ppermute/shard_map")
+    if not g.is_member():
+        raise RuntimeError(f"send: this rank is not a member of {g}")
+    me = g.ranks[g.rank]
+    _p2p_pair(tensor, me, dst, g)
+    return _Task([])
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
+    """Receive from global rank src into `tensor` (in-place; matched with a
+    `send` on src)."""
     g = _group(group)
     if g.nranks <= 1:
         return _Task([tensor])
-    raise NotImplementedError("eager p2p recv: see send()")
+    if not _multiproc():
+        raise RuntimeError("eager p2p recv across ranks needs jax.distributed")
+    if not g.is_member():
+        raise RuntimeError(f"recv: this rank is not a member of {g}")
+    me = g.ranks[g.rank]
+    tensor._data = jnp.asarray(_p2p_pair(tensor, 0 if src is None else src, me, g))
+    return _Task([tensor])
 
 
 def isend(tensor, dst, group=None):

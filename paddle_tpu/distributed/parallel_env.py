@@ -74,6 +74,11 @@ def init_parallel_env():
     if _initialized:
         return ParallelEnv()
     env = ParallelEnv()
+    # local-cluster simulation (the reference's TestDistBase pattern,
+    # test/legacy_test/test_dist_base.py:962): trainer processes pin the CPU
+    # backend BEFORE jax initializes so the single real TPU isn't fought over
+    if os.getenv("PADDLE_DIST_DEVICE", "").lower() == "cpu":
+        jax.config.update("jax_platforms", "cpu")
     if env.world_size > 1 and os.getenv("PADDLE_DIST_BACKEND", "xla") == "xla":
         master = os.getenv("PADDLE_MASTER")
         if master is None and env.trainer_endpoints:

@@ -1,0 +1,83 @@
+"""Per-rank collective checks, launched as a local multi-process cluster by
+tests/test_multiprocess_dist.py through the launch CLI (the reference's
+`test/collective/collective_*_api.py` scripts run under TestDistBase).
+
+Each rank exercises the eager collective surface across real processes and
+prints `RANK <r> COLLECTIVES OK` on success.
+"""
+import os
+import sys
+
+# one virtual CPU device per process (overrides any inherited 8-device flag —
+# repeated absl flags: last one wins)
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=1"
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+
+def main():
+    env = dist.init_parallel_env()
+    rank, world = env.rank, env.world_size
+    import jax
+    assert jax.process_count() == world, (jax.process_count(), world)
+
+    # all_reduce(SUM)
+    t = paddle.to_tensor(np.array([float(rank + 1)], np.float32))
+    dist.all_reduce(t)
+    assert float(t._data[0]) == world * (world + 1) / 2, np.asarray(t._data)
+
+    # all_reduce(MAX)
+    t = paddle.to_tensor(np.array([float(rank)], np.float32))
+    dist.all_reduce(t, op=dist.ReduceOp.MAX)
+    assert float(t._data[0]) == world - 1
+
+    # all_gather
+    outs = []
+    dist.all_gather(outs, paddle.to_tensor(np.array([rank], np.int32)))
+    assert [int(o._data[0]) for o in outs] == list(range(world))
+
+    # broadcast from rank 1
+    b = paddle.to_tensor(np.array([rank * 10.0], np.float32))
+    dist.broadcast(b, src=1)
+    assert float(b._data[0]) == 10.0
+
+    # alltoall: rank r sends slot j = r*world + j; receives [j*world + r]
+    ins = [paddle.to_tensor(np.array([rank * world + j], np.int32))
+           for j in range(world)]
+    outs2 = []
+    dist.alltoall(outs2, ins)
+    assert [int(o._data[0]) for o in outs2] == \
+        [j * world + rank for j in range(world)]
+
+    # reduce_scatter
+    rs_in = [paddle.to_tensor(np.array([float(j)], np.float32))
+             for j in range(world)]
+    rs_out = paddle.to_tensor(np.zeros(1, np.float32))
+    dist.reduce_scatter(rs_out, rs_in)
+    assert float(rs_out._data[0]) == rank * world
+
+    # object collective
+    objs = []
+    dist.all_gather_object(objs, {"rank": rank, "msg": "hi" * (rank + 1)})
+    assert [o["rank"] for o in objs] == list(range(world))
+
+    # matched-pair send/recv: 0 -> last
+    if world >= 2:
+        last = world - 1
+        if rank == 0:
+            dist.send(paddle.to_tensor(np.array([123.5], np.float32)), dst=last)
+        elif rank == last:
+            r = paddle.to_tensor(np.zeros(1, np.float32))
+            dist.recv(r, src=0)
+            assert float(r._data[0]) == 123.5
+
+    dist.barrier()
+    print(f"RANK {rank} COLLECTIVES OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

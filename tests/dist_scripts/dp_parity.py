@@ -1,0 +1,61 @@
+"""DataParallel loss/param parity trainer (the reference TestDistBase pattern:
+`test/legacy_test/test_dist_base.py:962` — parallel run must match serial).
+
+Every rank trains the same seeded MLP on its contiguous batch shard under
+`dist.DataParallel` (per-param allreduce hooks); rank prints a JSON line with
+its losses and a parameter checksum.  The parent test recomputes the serial
+(full-batch, single-process) run and asserts the checksums agree.
+"""
+import json
+import os
+
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=1"
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+
+def build_model():
+    import paddle_tpu.nn as nn
+    paddle.framework.random.seed(1234)
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+def run(world, rank):
+    import paddle_tpu.nn.functional as F
+    model = build_model()
+    if world > 1:
+        model = dist.DataParallel(model)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, 8).astype(np.float32)
+    Y = rng.randint(0, 4, (16,)).astype(np.int64)
+    per = 16 // world
+    xs = X[rank * per:(rank + 1) * per]
+    ys = Y[rank * per:(rank + 1) * per]
+    losses = []
+    for _ in range(3):
+        out = model(paddle.to_tensor(xs))
+        loss = F.cross_entropy(out, paddle.to_tensor(ys))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss._data))
+    ps = sum(float(np.abs(np.asarray(p._data)).sum())
+             for p in model.parameters())
+    return losses, ps
+
+
+def main():
+    env = dist.init_parallel_env()
+    losses, ps = run(env.world_size, env.rank)
+    print("DPRESULT " + json.dumps(
+        {"rank": env.rank, "losses": losses, "param_sum": ps}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
