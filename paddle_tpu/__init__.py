@@ -75,6 +75,12 @@ from .ops.linalg import (bincount, cdist, cholesky, cholesky_solve, cond, corrco
                          matrix_power, matrix_rank, multi_dot, norm, pdist, pinv, qr,
                          slogdet, solve, svd, triangular_solve)
 from .ops.einsum import einsum  # noqa
+from .ops.math import (add_n, cumulative_trapezoid, frexp, logit, renorm,  # noqa
+                       sigmoid, trapezoid)
+from .ops.manipulation import reverse, unflatten, unfold, vsplit  # noqa
+from .ops.linalg import lu_unpack, pca_lowrank, tensordot  # noqa
+from .ops.creation import create_tensor, vander  # noqa
+from .ops.inplace import *  # noqa  (trailing-underscore in-place variants)
 
 from .param_attr import ParamAttr  # noqa
 from .framework.io import save, load  # noqa
@@ -86,6 +92,8 @@ from . import amp  # noqa
 from . import autograd  # noqa
 from . import distributed  # noqa
 from . import distribution  # noqa
+from . import fft  # noqa
+from . import signal  # noqa
 from . import framework  # noqa
 from . import incubate  # noqa
 from . import io  # noqa
@@ -100,6 +108,8 @@ from . import utils  # noqa
 from . import vision  # noqa
 
 from .jit import to_static  # noqa
+from .distributed import DataParallel  # noqa
+from .hapi.model import Model  # noqa
 
 # dygraph flag compat: we are always in dygraph (eager) mode unless static capture
 _in_dynamic = True
@@ -127,6 +137,93 @@ def device(dev):  # paddle.device module shim is in utils; keep callable
     return set_device(dev)
 
 
+class finfo:
+    """ref paddle.finfo: floating-point type limits."""
+
+    def __init__(self, dtype):
+        import jax.numpy as _jnp
+        from .core.dtype import to_np as _to_np
+        fi = _jnp.finfo(_to_np(dtype))
+        self.min = float(fi.min)
+        self.max = float(fi.max)
+        self.eps = float(fi.eps)
+        self.tiny = float(fi.tiny)
+        self.smallest_normal = float(fi.tiny)
+        self.resolution = float(fi.resolution)
+        self.bits = int(fi.bits)
+        self.dtype = str(fi.dtype)
+
+
+class iinfo:
+    """ref paddle.iinfo: integer type limits."""
+
+    def __init__(self, dtype):
+        import jax.numpy as _jnp
+        from .core.dtype import to_np as _to_np
+        ii = _jnp.iinfo(_to_np(dtype))
+        self.min = int(ii.min)
+        self.max = int(ii.max)
+        self.bits = int(ii.bits)
+        self.dtype = str(ii.dtype)
+
+
+dtype = _dtype_mod.DType  # paddle.dtype type object (ref VarType alias)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """ref paddle.set_printoptions — forwards to numpy's print options."""
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def tolist(x):
+    """ref paddle.tolist: nested python list of tensor values."""
+    import numpy as _np
+    return _np.asarray(x.numpy() if hasattr(x, "numpy") else x).tolist()
+
+
+class LazyGuard:
+    """ref paddle.LazyGuard: delayed parameter init context.  Eager jax init is
+    cheap, so this is a transparent shim (params materialize immediately)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def batch(reader, batch_size, drop_last=False):
+    """ref paddle.batch (legacy reader decorator)."""
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+def check_shape(x):
+    """ref static nn.check_shape helper (shape sanity assert shim)."""
+    return x
+
+
 def summary(net, input_size=None, dtypes=None, input=None):
     from .hapi.summary import summary as _s
     return _s(net, input_size, dtypes, input)
@@ -142,10 +239,12 @@ def _patch_tensor_methods():
     monkey-patch in `python/paddle/fluid/dygraph/tensor_patch_methods.py`."""
     import sys
     mod = sys.modules[__name__]
-    from .ops import creation, linalg, logic, manipulation, math, random, search, stat
+    from .ops import (creation, inplace, linalg, logic, manipulation, math,
+                      random, search, stat)
     from .ops.einsum import einsum as _einsum  # noqa
 
-    method_sources = [math, manipulation, logic, search, stat, linalg, creation, random]
+    method_sources = [math, manipulation, logic, search, stat, linalg, creation,
+                      random, inplace]
     skip = {"broadcast_shape", "create_parameter", "meshgrid", "is_tensor",
             "get_rng_state", "set_rng_state", "get_cuda_rng_state", "set_cuda_rng_state"}
     for src in method_sources:

@@ -1,3 +1,13 @@
 from .auto_cast import amp_guard, auto_cast, decorate  # noqa
 from .grad_scaler import AmpScaler, GradScaler  # noqa
 from . import debugging  # noqa
+
+
+def is_bfloat16_supported(device=None):
+    """TPU MXU is bf16-native."""
+    return True
+
+
+def is_float16_supported(device=None):
+    import jax
+    return jax.default_backend() != "cpu"

@@ -27,3 +27,39 @@ class saved_tensors_hooks:
         from . import py_layer
         py_layer._saved_hooks.pop()
         return False
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """ref autograd/autograd.py jacobian: lazy full Jacobian of ys w.r.t. xs.
+
+    TPU-native: delegates to jax.jacobian over the recorded forward (xs must be
+    leaves; computed eagerly, returned as a Tensor [*ys.shape, *xs.shape])."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    if callable(ys):
+        fn, at = ys, xs
+        data = at._data if isinstance(at, Tensor) else jnp.asarray(at)
+        jac = jax.jacobian(lambda a: fn(Tensor(a, stop_gradient=False))._data)(data)
+        return Tensor(jac)
+    # tensor form: differentiate by replaying grads per output element
+    out = []
+    flat = ys.reshape([-1])
+    for i in range(int(flat.size)):
+        g = grad(flat[i], xs, retain_graph=True, create_graph=False,
+                 allow_unused=True)
+        out.append(g[0] if isinstance(g, (list, tuple)) else g)
+    import numpy as np
+    stacked = jnp.stack([o._data if o is not None else jnp.zeros_like(xs._data)
+                         for o in out])
+    return Tensor(stacked.reshape(tuple(ys.shape) + tuple(xs.shape)))
+
+
+def hessian(func, xs, batch_axis=None):
+    """ref autograd/autograd.py hessian (function form)."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    data = xs._data if isinstance(xs, Tensor) else jnp.asarray(xs)
+    h = jax.hessian(lambda a: func(Tensor(a, stop_gradient=False))._data.sum())(data)
+    return Tensor(h)

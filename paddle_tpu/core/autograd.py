@@ -82,7 +82,8 @@ class GradNode:
     """
 
     __slots__ = ("name", "vjp_fn", "inputs", "n_outputs", "out_specs", "out_refs",
-                 "jfn", "in_datas", "out_tuple", "id", "__weakref__")
+                 "jfn", "in_datas", "out_tuple", "id", "input_versions",
+                 "__weakref__")
 
     _counter = 0
 
@@ -91,6 +92,10 @@ class GradNode:
         self.name = name
         self.vjp_fn = vjp_fn
         self.inputs = list(inputs)  # strong refs (TensorWrapper parity)
+        # inplace-version snapshot (ref: TensorWrapper inplace_version_snapshot_):
+        # backward errors if an input was modified in place after being recorded
+        self.input_versions = [getattr(t, "_version", 0) if t is not None else None
+                               for t in self.inputs]
         self.n_outputs = n_outputs
         self.out_specs = out_specs  # [(shape, dtype)] per output, for zero-filling
         self.out_refs = None  # {out_index: [weakref(Tensor)]} for hooks/retain_grads
@@ -369,6 +374,16 @@ def _engine_impl(tensors, grad_tensors, retain_graph, inputs, create_graph,
                 raise RuntimeError(
                     f"Trying to run backward through {node.name} a second time. Set "
                     "retain_graph=True on the first backward if you need this.")
+            # inplace version check (ref eager inplace version counter): a tensor
+            # recorded as this node's input must not have been modified in place
+            # since — silent wrong gradients are worse than an exception
+            for _inp, _ver in zip(node.inputs, node.input_versions):
+                if _ver is not None and getattr(_inp, "_version", 0) != _ver:
+                    raise RuntimeError(
+                        "one of the variables needed for gradient computation has "
+                        f"been modified by an inplace operation: input of "
+                        f"'{node.name}' is at version "
+                        f"{getattr(_inp, '_version', 0)}, expected {_ver}")
             if create_graph:
                 in_cots = _replay_pullback(node, bufs)
             else:

@@ -66,6 +66,7 @@ class Tensor:
         self.is_distributed = False
         self._optimize_attrs = {}
         self._backward_hooks = []
+        self._version = 0  # inplace version counter (ref inplace_version)
         if name is None:
             Tensor._name_counter += 1
             name = f"generated_tensor_{Tensor._name_counter}"
@@ -313,6 +314,7 @@ class Tensor:
         self._grad_node = out._grad_node
         self._out_index = out._out_index
         self.stop_gradient = out.stop_gradient
+        self._version += 1  # prior tape readers of self now error in backward
 
     # ---- arithmetic dunders (full set; implementations are jnp lambdas) ----
     def __add__(self, o):
@@ -402,9 +404,25 @@ class Tensor:
 
     # in-place variants (trailing-underscore, paddle style): rebind data
     def _inplace_from(self, out: "Tensor"):
+        node = out._grad_node
+        if node is not None:
+            # the producing node recorded *this object* as its input; after the
+            # rebind that would be a self-loop in the tape (and a stale read).
+            # Swap in a snapshot carrying the pre-op state (reference: eager
+            # inplace version snapshot in TensorWrapper).
+            snap = None
+            for i, inp in enumerate(node.inputs):
+                if inp is self:
+                    if snap is None:
+                        snap = Tensor(self._data, stop_gradient=self.stop_gradient)
+                        snap._grad_node = self._grad_node
+                        snap._out_index = self._out_index
+                        snap._version = self._version
+                    node.inputs[i] = snap
         self._data = out._data
         self._grad_node = out._grad_node
         self._out_index = out._out_index
+        self._version += 1
         return self
 
     def add_(self, o):
@@ -424,14 +442,17 @@ class Tensor:
 
     def zero_(self):
         self._data = jnp.zeros_like(self._data)
+        self._version += 1
         return self
 
     def fill_(self, value):
         self._data = jnp.full_like(self._data, value)
+        self._version += 1
         return self
 
     def copy_(self, other, blocking=True):
         self._data = _to_data(other).astype(self._data.dtype)
+        self._version += 1
         return self
 
     def set_value(self, value):

@@ -399,3 +399,151 @@ def kl_divergence(p, q):
     # fallback: monte-carlo estimate
     x = p.sample((256,))
     return Tensor(jnp.mean(_t(p.log_prob(x)) - _t(q.log_prob(x)), axis=0))
+
+
+# ---- breadth additions (ref distribution/cauchy.py, exponential_family.py,
+# independent.py, kl.py register_kl) ----
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _to_data(loc)
+        self.scale = _to_data(scale)
+        super().__init__(batch_shape=jnp.broadcast_shapes(
+            jnp.shape(self.loc), jnp.shape(self.scale)))
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy distribution has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy distribution has no variance")
+
+    @property
+    def stddev(self):
+        raise ValueError("Cauchy distribution has no stddev")
+
+    def sample(self, shape=()):
+        k = _gen.next_key()
+        shp = tuple(shape) + tuple(self.batch_shape)
+        return Tensor(self.loc + self.scale * jax.random.cauchy(k, shp))
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        v = _to_data(value)
+        z = (v - self.loc) / self.scale
+        return Tensor(-jnp.log(jnp.pi) - jnp.log(self.scale) - jnp.log1p(z * z))
+
+    def prob(self, value):
+        return Tensor(jnp.exp(self.log_prob(value)._data))
+
+    def cdf(self, value):
+        v = _to_data(value)
+        return Tensor(jnp.arctan((v - self.loc) / self.scale) / jnp.pi + 0.5)
+
+    def entropy(self):
+        return Tensor(jnp.log(4 * jnp.pi) + jnp.log(self.scale)
+                      + jnp.zeros(self.batch_shape))
+
+    def kl_divergence(self, other):
+        # closed form between two Cauchys (Chyzak-Nielsen 2019)
+        t = ((self.scale + other.scale) ** 2 + (self.loc - other.loc) ** 2) / \
+            (4 * self.scale * other.scale)
+        return Tensor(jnp.log(t))
+
+
+class ExponentialFamily(Distribution):
+    """ref exponential_family.py: entropy via Bregman divergence of the
+    log-normalizer.  Subclasses provide _natural_parameters/_log_normalizer."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0
+
+    def entropy(self):
+        nparams = [jnp.asarray(p) for p in self._natural_parameters]
+        lg = self._log_normalizer(*[Tensor(p) for p in nparams])
+        lg_data = lg._data if isinstance(lg, Tensor) else jnp.asarray(lg)
+        result = lg_data - self._mean_carrier_measure
+        # E[T(x)] . eta  via grad of log-normalizer
+        g = jax.grad(lambda *ps: jnp.sum(
+            (self._log_normalizer(*[Tensor(p) for p in ps])._data
+             if isinstance(self._log_normalizer(*[Tensor(p) for p in ps]), Tensor)
+             else self._log_normalizer(*ps))))(*nparams)
+        gs = g if isinstance(g, (tuple, list)) else (g,)
+        for p, gp in zip(nparams, gs):
+            result = result - p * gp
+        return Tensor(result)
+
+
+class Independent(Distribution):
+    """ref independent.py: reinterprets batch dims as event dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        bshape = tuple(getattr(base, "batch_shape", ()))
+        k = self.reinterpreted_batch_rank
+        super().__init__(batch_shape=bshape[:len(bshape) - k],
+                         event_shape=bshape[len(bshape) - k:])
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        d = lp._data if isinstance(lp, Tensor) else jnp.asarray(lp)
+        axes = tuple(range(d.ndim - self.reinterpreted_batch_rank, d.ndim))
+        return Tensor(jnp.sum(d, axis=axes) if axes else d)
+
+    def entropy(self):
+        e = self.base.entropy()
+        d = e._data if isinstance(e, Tensor) else jnp.asarray(e)
+        axes = tuple(range(d.ndim - self.reinterpreted_batch_rank, d.ndim))
+        return Tensor(jnp.sum(d, axis=axes) if axes else d)
+
+
+_KL_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    """ref kl.py register_kl: decorator registering a KL(p||q) rule."""
+    def decorator(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+    return decorator
+
+
+_builtin_kl = kl_divergence
+
+
+def kl_divergence(p, q):  # noqa: F811 — registry-aware front end
+    for (cp, cq), fn in _KL_REGISTRY.items():
+        if isinstance(p, cp) and isinstance(q, cq):
+            return fn(p, q)
+    if hasattr(p, "kl_divergence") and type(p) is type(q):
+        try:
+            return p.kl_divergence(q)
+        except (NotImplementedError, AttributeError):
+            pass
+    return _builtin_kl(p, q)

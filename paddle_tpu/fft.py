@@ -1,0 +1,117 @@
+"""paddle.fft — discrete Fourier transforms.
+
+Reference parity: `python/paddle/fft.py` (fft_c2c/c2r/r2c kernels in
+`phi/kernels/fft_*`).  TPU-native: every transform lowers to XLA's FFT HLO via
+jnp.fft; calls dispatch through `core.tensor.apply` so they record on the eager
+tape and run under `to_static` capture.  `norm` semantics ("backward" | "ortho"
+| "forward") match numpy/reference.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.tensor import apply
+
+
+def _norm(norm):
+    if norm is None:
+        return "backward"
+    if norm not in ("backward", "ortho", "forward"):
+        raise ValueError(f"Unexpected norm: {norm!r}; expected 'forward', "
+                         "'backward' or 'ortho'")
+    return norm
+
+
+def _wrap1(jfn, opname):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        nm = _norm(norm)
+        return apply(opname, lambda a: jfn(a, n=n, axis=axis, norm=nm), x)
+    op.__name__ = opname
+    return op
+
+
+def _wrap2(jfn, opname):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        nm = _norm(norm)
+        return apply(opname, lambda a: jfn(a, s=s, axes=axes, norm=nm), x)
+    op.__name__ = opname
+    return op
+
+
+def _wrapn(jfn, opname):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        nm = _norm(norm)
+        return apply(opname, lambda a: jfn(a, s=s, axes=axes, norm=nm), x)
+    op.__name__ = opname
+    return op
+
+
+fft = _wrap1(jnp.fft.fft, "fft")
+ifft = _wrap1(jnp.fft.ifft, "ifft")
+rfft = _wrap1(jnp.fft.rfft, "rfft")
+irfft = _wrap1(jnp.fft.irfft, "irfft")
+hfft = _wrap1(jnp.fft.hfft, "hfft")
+ihfft = _wrap1(jnp.fft.ihfft, "ihfft")
+
+fft2 = _wrap2(jnp.fft.fft2, "fft2")
+ifft2 = _wrap2(jnp.fft.ifft2, "ifft2")
+rfft2 = _wrap2(jnp.fft.rfft2, "rfft2")
+irfft2 = _wrap2(jnp.fft.irfft2, "irfft2")
+
+fftn = _wrapn(jnp.fft.fftn, "fftn")
+ifftn = _wrapn(jnp.fft.ifftn, "ifftn")
+rfftn = _wrapn(jnp.fft.rfftn, "rfftn")
+irfftn = _wrapn(jnp.fft.irfftn, "irfftn")
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    # jnp.fft lacks hfft2/hfftn; compose: hermitian along last axis, c2c on rest
+    nm = _norm(norm)
+
+    def f(a):
+        other = tuple(axes[:-1])
+        out = jnp.fft.ifftn(a, s=None if s is None else s[:-1], axes=other,
+                            norm=nm) if other else a
+        return jnp.fft.hfft(out, n=None if s is None else s[-1], axis=axes[-1],
+                            norm=nm)
+    return apply("hfft2", f, x)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    nm = _norm(norm)
+
+    def f(a):
+        out = jnp.fft.ihfft(a, n=None if s is None else s[-1], axis=axes[-1],
+                            norm=nm)
+        other = tuple(axes[:-1])
+        return jnp.fft.fftn(out, s=None if s is None else s[:-1], axes=other,
+                            norm=nm) if other else out
+    return apply("ihfft2", f, x)
+
+
+hfftn = hfft2
+ihfftn = ihfft2
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+    return Tensor(jnp.fft.fftfreq(n, d).astype(dtype or jnp.float32))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype or jnp.float32))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply("fftshift", lambda a: jnp.fft.fftshift(a, axes=axes), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=axes), x)
+
+
+__all__ = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn", "rfft", "irfft",
+           "rfft2", "irfft2", "rfftn", "irfftn", "hfft", "ihfft", "hfft2",
+           "ihfft2", "hfftn", "ihfftn", "fftfreq", "rfftfreq", "fftshift",
+           "ifftshift"]

@@ -5,3 +5,23 @@ from .nn import functional  # noqa
 
 def autotune(config=None):
     pass
+
+from .segment_ops import (graph_send_recv, identity_loss, segment_max,  # noqa
+                          segment_mean, segment_min, segment_sum)
+from .optimizer import LookAhead, ModelAverage  # noqa
+from ..nn.functional.sparse_ops import (softmax_mask_fuse,  # noqa
+                                        softmax_mask_fuse_upper_triangle)
+
+
+def graph_khop_sampler(*args, **kwargs):
+    raise NotImplementedError(
+        "graph_khop_sampler: dynamic-shape neighbor sampling is host-side; see "
+        "paddle_tpu.geometric for the TPU-native message-passing path")
+
+
+def graph_sample_neighbors(*args, **kwargs):
+    raise NotImplementedError("see paddle_tpu.geometric sampling note")
+
+
+def graph_reindex(*args, **kwargs):
+    raise NotImplementedError("see paddle_tpu.geometric sampling note")

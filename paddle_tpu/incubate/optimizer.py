@@ -1,0 +1,91 @@
+"""incubate optimizers: LookAhead, ModelAverage.
+
+Reference parity: `python/paddle/incubate/optimizer/lookahead.py`,
+`modelaverage.py`.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..optimizer.optimizer import Optimizer
+
+
+class LookAhead(Optimizer):
+    """k steps forward, 1 step back (Zhang et al. 2019; ref lookahead.py)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = int(k)
+        self._step_count = 0
+        self._slow = {}
+        self._parameter_list = inner_optimizer._parameter_list
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            for p in self._parameter_list:
+                pid = id(p)
+                if pid not in self._slow:
+                    self._slow[pid] = p._data
+                slow = self._slow[pid] + self.alpha * (p._data - self._slow[pid])
+                self._slow[pid] = slow
+                p._data = slow
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    def state_dict(self):
+        return {"inner": self.inner_optimizer.state_dict(),
+                "step": self._step_count}
+
+    def set_state_dict(self, state):
+        self.inner_optimizer.set_state_dict(state.get("inner", {}))
+        self._step_count = state.get("step", 0)
+
+
+class ModelAverage(Optimizer):
+    """Running average of parameters for eval (ref modelaverage.py)."""
+
+    def __init__(self, average_window_rate, parameters=None, min_average_window=10000,
+                 max_average_window=10000, name=None):
+        self._parameter_list = list(parameters or [])
+        self.avg = {id(p): p._data for p in self._parameter_list}
+        self.n = 0
+        self._backup = None
+
+    def step(self):
+        self.n += 1
+        for p in self._parameter_list:
+            pid = id(p)
+            self.avg[pid] = self.avg[pid] + (p._data - self.avg[pid]) / self.n
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            backup = {id(p): p._data for p in self._parameter_list}
+            for p in self._parameter_list:
+                p._data = self.avg[id(p)]
+            try:
+                yield
+            finally:
+                if need_restore:
+                    for p in self._parameter_list:
+                        p._data = backup[id(p)]
+        return guard()
+
+    def restore(self, executor=None):
+        pass
+
+    def clear_grad(self, set_to_zero=True):
+        pass

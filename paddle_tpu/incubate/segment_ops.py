@@ -1,0 +1,68 @@
+"""Segment reductions + graph message passing.
+
+Reference parity: `python/paddle/incubate/__init__.py` segment_sum/mean/max/min
+(`phi/kernels/segment_pool_kernel.*`) and `graph_send_recv`
+(`phi/kernels/graph_send_recv_kernel.*`).  TPU-native: jax.ops.segment_* are
+XLA scatter-reductions — one fused kernel, no atomics.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import apply
+
+
+def segment_sum(data, segment_ids, name=None):
+    return apply("segment_sum",
+                 lambda d, i: jax.ops.segment_sum(d, i.astype(jnp.int32)),
+                 data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    def f(d, i):
+        i = i.astype(jnp.int32)
+        s = jax.ops.segment_sum(d, i)
+        cnt = jax.ops.segment_sum(jnp.ones((d.shape[0],), d.dtype), i)
+        return s / jnp.maximum(cnt, 1.0).reshape((-1,) + (1,) * (d.ndim - 1))
+    return apply("segment_mean", f, data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    return apply("segment_max",
+                 lambda d, i: jax.ops.segment_max(d, i.astype(jnp.int32)),
+                 data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    return apply("segment_min",
+                 lambda d, i: jax.ops.segment_min(d, i.astype(jnp.int32)),
+                 data, segment_ids)
+
+
+def graph_send_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                    name=None):
+    """ref graph_send_recv: gather x[src], reduce into dst buckets."""
+    red = {"sum": jax.ops.segment_sum, "mean": None, "max": jax.ops.segment_max,
+           "min": jax.ops.segment_min}[reduce_op]
+
+    def f(a, si, di):
+        msgs = a[si.astype(jnp.int32)]
+        n = out_size or a.shape[0]
+        di32 = di.astype(jnp.int32)
+        if reduce_op == "mean":
+            s = jax.ops.segment_sum(msgs, di32, num_segments=n)
+            cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],), a.dtype), di32,
+                                      num_segments=n)
+            return s / jnp.maximum(cnt, 1.0).reshape((-1,) + (1,) * (a.ndim - 1))
+        return red(msgs, di32, num_segments=n)
+    return apply("graph_send_recv", f, x, src_index, dst_index)
+
+
+def identity_loss(x, reduction="none"):
+    """ref incubate identity_loss (IPU custom-loss marker)."""
+    if reduction in (0, "sum"):
+        return x.sum()
+    if reduction in (1, "mean"):
+        return x.mean()
+    return x

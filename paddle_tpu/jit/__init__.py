@@ -1,2 +1,25 @@
 from .api import TranslatedLayer, load, not_to_static, save, to_static  # noqa
 from .program import StaticFunction, functionalize  # noqa
+
+
+_to_static_enabled = True
+
+
+def enable_to_static(enable=True):
+    """ref jit/api.py enable_to_static: global switch for @to_static capture."""
+    global _to_static_enabled
+    _to_static_enabled = bool(enable)
+
+
+def ignore_module(modules):
+    """ref dy2static ignore_module: tracing capture has no AST blacklist; no-op."""
+    return None
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """ref dy2static logging: tracing capture emits no transformed code."""
+    return None
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    return None

@@ -5,3 +5,4 @@ from .layer import *  # noqa
 from .layer import Layer  # noqa
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa
 from .utils import clip_grad_norm_, clip_grad_value_, parameters_to_vector, vector_to_parameters  # noqa
+from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa

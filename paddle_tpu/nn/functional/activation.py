@@ -174,3 +174,21 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
             y = onehot + y - jax.lax.stop_gradient(y)
         return y
     return apply("gumbel_softmax", f, x)
+
+
+# ---- in-place variants (ref activation.py elu_ etc.) ----
+
+def elu_(x, alpha=1.0, name=None):
+    return x._inplace_from(elu(x, alpha))
+
+
+def hardtanh_(x, min=-1.0, max=1.0, name=None):
+    return x._inplace_from(hardtanh(x, min, max))
+
+
+def leaky_relu_(x, negative_slope=0.01, name=None):
+    return x._inplace_from(leaky_relu(x, negative_slope))
+
+
+def thresholded_relu_(x, threshold=1.0, value=0.0, name=None):
+    return x._inplace_from(thresholded_relu(x, threshold, value))

@@ -307,3 +307,211 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
             return jnp.mean(per / jnp.maximum(ll.astype(jnp.float32), 1.0))
         return _reduce(per, reduction)
     return apply("ctc_loss", f, log_probs, labels, input_lengths, label_lengths)
+
+
+# ---- breadth additions (reference nn/functional/loss.py) ----
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    """ref loss.py gaussian_nll_loss."""
+    def f(mu, y, var):
+        v = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(v) + (y - mu) ** 2 / v)
+        if full:
+            loss = loss + 0.5 * jnp.log(2 * jnp.pi)
+        if reduction == "mean":
+            return jnp.mean(loss)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+    return apply("gaussian_nll_loss", f, input, label, variance)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """ref loss.py multi_margin_loss (hinge over classes)."""
+    def f(x, y, *w):
+        n, c = x.shape
+        picked = jnp.take_along_axis(x, y[:, None].astype(jnp.int32), axis=1)
+        m = jnp.maximum(0.0, margin - picked + x) ** p
+        if w:
+            # reference semantics: the whole sample is weighted by weight[y]
+            m = m * w[0][y.astype(jnp.int32)][:, None]
+        m = m.at[jnp.arange(n), y.astype(jnp.int32)].set(0.0)
+        loss = jnp.sum(m, axis=1) / c
+        if reduction == "mean":
+            return jnp.mean(loss)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply("multi_margin_loss", f, *args)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    """ref loss.py triplet_margin_with_distance_loss."""
+    from ...core.tensor import Tensor as _T
+
+    def pairwise(a, b):
+        return jnp.sqrt(jnp.sum((a - b) ** 2, axis=-1) + 1e-12)
+
+    if distance_function is not None:
+        dp = distance_function(input, positive)
+        dn = distance_function(input, negative)
+        if swap:
+            dpn = distance_function(positive, negative)
+            dn = apply("minimum", jnp.minimum, dn, dpn)
+        loss = apply("triplet_hinge",
+                     lambda a, b: jnp.maximum(a - b + margin, 0.0), dp, dn)
+    else:
+        def f(x, pos, neg):
+            dp = pairwise(x, pos)
+            dn = pairwise(x, neg)
+            if swap:
+                dn = jnp.minimum(dn, pairwise(pos, neg))
+            return jnp.maximum(dp - dn + margin, 0.0)
+        loss = apply("triplet_margin_with_distance", f, input, positive, negative)
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None, path_table=None,
+                  path_code=None, is_sparse=False, name=None):
+    """ref loss.py hsigmoid_loss (hierarchical sigmoid over the default
+    complete binary tree when no custom path is given).
+
+    Heap layout: internal nodes are ids [0, C-2], leaves [C-1, 2C-2] (exactly
+    C-1 internal nodes for ANY class count); class c's path walks parents from
+    leaf id c + C - 1 to the root, so leaf probabilities sum to 1."""
+    import math as _m
+    C = int(num_classes)
+    depth = max(int(_m.ceil(_m.log2(max(C, 2)))) + 1, 1)
+
+    def f(x, y, w, *b):
+        yy = y.astype(jnp.int32).reshape(-1)
+        node = yy + (C - 1)                                  # leaf id
+        total = 0.0
+        for _ in range(depth):
+            valid = node > 0
+            parent = jnp.maximum((node - 1) // 2, 0)
+            is_right = (node == 2 * parent + 2)
+            logits = jnp.einsum("nd,nd->n", w[parent], x)
+            if b:
+                logits = logits + b[0].reshape(-1)[parent]
+            sign = jnp.where(is_right, -1.0, 1.0)            # left: +, right: -
+            total = total + jnp.where(valid,
+                                      jax.nn.softplus(-sign * logits), 0.0)
+            node = jnp.where(valid, parent, 0)
+        return jnp.mean(total)
+    args = (input, label, weight) + ((bias,) if bias is not None else ())
+    return apply("hsigmoid_loss", f, *args)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean", name=None):
+    """ref loss.py margin_cross_entropy (ArcFace/CosFace family margins):
+    cos(m1*theta + m2) - m3 applied to the target logit, then scaled CE."""
+    def f(lg, y):
+        yi = y.astype(jnp.int32).reshape(-1)
+        n = lg.shape[0]
+        target = lg[jnp.arange(n), yi]
+        target = jnp.clip(target, -1.0, 1.0)
+        theta = jnp.arccos(target)
+        adj = jnp.cos(margin1 * theta + margin2) - margin3
+        lg2 = lg.at[jnp.arange(n), yi].set(adj) * scale
+        lsm = jax.nn.log_softmax(lg2, axis=-1)
+        loss = -lsm[jnp.arange(n), yi]
+        if reduction == "mean":
+            loss = jnp.mean(loss)
+        elif reduction == "sum":
+            loss = jnp.sum(loss)
+        if return_softmax:
+            return loss, jnp.exp(lsm)
+        return loss
+    return apply("margin_cross_entropy", f, logits, label)
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-T transducer loss (ref loss.py rnnt_loss / warprnnt).
+
+    input: [B, T, U+1, V] log-probs (or logits — log_softmax applied), label
+    [B, U].  Forward-variable DP in log space with lax.scan over T; the U
+    recurrence runs as an inner scan (log-semiring linear recurrence).
+    """
+    def f(acts, lab, ilen, llen):
+        lp = jax.nn.log_softmax(acts, axis=-1)
+        B, T, U1, V = lp.shape
+        U = U1 - 1
+        lab32 = lab.astype(jnp.int32)
+        blank_lp = lp[..., blank]                              # [B, T, U+1]
+        # emit[b, t, u] = log p(label_u | t, u)  for u < U
+        emit_lp = jnp.take_along_axis(
+            lp[:, :, :U, :], lab32[:, None, :, None], axis=-1)[..., 0]  # [B,T,U]
+        if fastemit_lambda:
+            # FastEmit (Yu et al. 2021), warprnnt formulation: loss value is
+            # unchanged but emit-transition gradients scale by (1 + lambda)
+            emit_lp = emit_lp + fastemit_lambda * (
+                emit_lp - jax.lax.stop_gradient(emit_lp))
+        NEG = -1e30
+
+        def row(alpha_prev, t):
+            # alpha_prev [B, U+1] = alpha[t-1, :]; move right via blank from
+            # above, then left-to-right emits within the row
+            from_top = jnp.where(t == 0,
+                                 jnp.where(jnp.arange(U1)[None] == 0, 0.0, NEG),
+                                 alpha_prev + blank_lp[:, jnp.maximum(t - 1, 0), :])
+
+            def cell(carry, u):
+                # carry: alpha[t, u-1]; combine with emit into u
+                left = carry + emit_lp[:, t, u - 1]
+                a = jnp.logaddexp(from_top[:, u], left)
+                return a, a
+
+            a0 = from_top[:, 0]
+            _, rest = jax.lax.scan(cell, a0, jnp.arange(1, U1))
+            alpha_t = jnp.concatenate([a0[:, None], rest.T], axis=1)
+            return alpha_t, alpha_t
+
+        _, alphas = jax.lax.scan(row, jnp.full((B, U1), NEG), jnp.arange(T))
+        alphas = jnp.moveaxis(alphas, 0, 1)                    # [B, T, U+1]
+        bi = jnp.arange(B)
+        tl = ilen.astype(jnp.int32) - 1
+        ul = llen.astype(jnp.int32)
+        ll = alphas[bi, tl, ul] + blank_lp[bi, tl, ul]
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+    return apply("rnnt_loss", f, input, label, input_lengths, label_lengths)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """ref common.py class_center_sample: sample negative class centers.
+
+    Returns (remapped_label, sampled_class_indices).  Positive classes always
+    kept; negatives fill up to num_samples (deterministic fill, matching the
+    reference's semantics though not its RNG)."""
+    import numpy as _np
+    from ...core.tensor import Tensor as _T
+    y = _np.asarray(label.numpy() if hasattr(label, "numpy") else label).reshape(-1)
+    pos = _np.unique(y)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        neg_pool = _np.setdiff1d(_np.arange(num_classes), pos)
+        rng = _np.random.RandomState(0)
+        extra = rng.choice(neg_pool, size=num_samples - len(pos), replace=False)
+        sampled = _np.concatenate([pos, _np.sort(extra)])
+    remap = -_np.ones(num_classes, _np.int64)
+    remap[sampled] = _np.arange(len(sampled))
+    return (_T(jnp.asarray(remap[y].reshape(y.shape), jnp.int64)),
+            _T(jnp.asarray(sampled, jnp.int64)))

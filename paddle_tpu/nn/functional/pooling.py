@@ -236,3 +236,46 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0, data_format="N
         out = out.at[ni, ci, flat_idx].set(vals)
         return out.reshape(n, c, oh, ow)
     return apply("max_unpool2d", f, x, indices)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """ref pooling.py max_unpool1d: scatter values back to argmax positions."""
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    s = (stride if isinstance(stride, int) else stride[0]) if stride is not None else k
+    p = padding if isinstance(padding, int) else padding[0]
+
+    def f(a, idx):
+        n, c, l = a.shape
+        ol = (output_size[-1] if output_size is not None
+              else (l - 1) * s + k - 2 * p)
+        out = jnp.zeros((n, c, ol), a.dtype)
+        ni = jnp.arange(n).reshape(-1, 1, 1)
+        ci = jnp.arange(c).reshape(1, -1, 1)
+        return out.at[ni, ci, idx.astype(jnp.int32)].set(a)
+    return apply("max_unpool1d", f, x, indices)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    """ref pooling.py max_unpool3d."""
+    k = _tup(kernel_size, 3)
+    s = _tup(stride if stride is not None else kernel_size, 3)
+    p = padding if isinstance(padding, int) else 0
+
+    def f(a, idx):
+        n, c, d, h, w = a.shape
+        if output_size is not None:
+            od, oh, ow = _tup(output_size, 3)[-3:]
+        else:
+            od = (d - 1) * s[0] + k[0] - 2 * p
+            oh = (h - 1) * s[1] + k[1] - 2 * p
+            ow = (w - 1) * s[2] + k[2] - 2 * p
+        out = jnp.zeros((n, c, od * oh * ow), a.dtype)
+        flat_idx = idx.reshape(n, c, -1).astype(jnp.int32)
+        vals = a.reshape(n, c, -1)
+        ni = jnp.arange(n).reshape(-1, 1, 1)
+        ci = jnp.arange(c).reshape(1, -1, 1)
+        out = out.at[ni, ci, flat_idx].set(vals)
+        return out.reshape(n, c, od, oh, ow)
+    return apply("max_unpool3d", f, x, indices)

@@ -21,3 +21,35 @@ def softmax_mask_fuse_upper_triangle(x):
         scores = jnp.where(mask, a.astype(jnp.float32), -1e30)
         return jax.nn.softmax(scores, axis=-1).astype(a.dtype)
     return apply("softmax_mask_fuse_upper_triangle", f, x)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """ref sparse_attention.py: attention restricted to a CSR sparsity pattern.
+
+    TPU-native: materializes the CSR pattern as a dense mask and runs one fused
+    masked softmax-matmul — on the MXU a dense masked matmul beats gather-based
+    sparse compute for the block densities this API targets."""
+    import jax
+    import jax.numpy as jnp
+    from ...core.tensor import apply
+
+    def f(q, k, v, off, cols):
+        B, H, T, D = q.shape
+        scores = jnp.einsum("bhtd,bhsd->bhts", q, k) / jnp.sqrt(
+            jnp.asarray(D, q.dtype))
+        # CSR -> dense mask [B, H, T, T]: nnz j belongs to row r iff
+        # off[r] <= j < off[r+1]; count boundaries <= j (batched searchsorted)
+        nnz = cols.shape[-1]
+        j = jnp.arange(nnz)
+        r = jnp.sum(j[..., None, :] >= off[..., 1:, None], axis=-2)  # [B,H,nnz]
+        mask = jnp.zeros((B, H, T, T), bool)
+        bi = jnp.arange(B)[:, None, None]
+        hi = jnp.arange(H)[None, :, None]
+        mask = mask.at[bi, hi, r, cols.astype(jnp.int32)].set(True)
+        scores = jnp.where(mask, scores, -1e30)
+        w = jax.nn.softmax(scores, axis=-1)
+        w = jnp.where(mask, w, 0.0)
+        return jnp.einsum("bhts,bhsd->bhtd", w, v)
+    return apply("sparse_attention", f, query, key, value, sparse_csr_offset,
+                 sparse_csr_columns)

@@ -66,3 +66,11 @@ class PReLU(Layer):
 
     def forward(self, x):
         return F.prelu(x, self.weight, data_format=self._data_format)
+
+
+class Softmax2D(Layer):
+    """ref activation.py Softmax2D: softmax over channel dim of NCHW/CHW."""
+
+    def forward(self, x):
+        assert x.ndim in (3, 4), f"Softmax2D expects 3D/4D input, got {x.ndim}D"
+        return F.softmax(x, axis=-3)

@@ -227,3 +227,16 @@ class Fold(Layer):
 
     def forward(self, x):
         return F.fold(x, self.output_sizes, *self.args)
+
+
+class Unflatten(Layer):
+    """ref common.py Unflatten: split one axis into a shape."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis = axis
+        self._shape = shape
+
+    def forward(self, x):
+        from ...ops.manipulation import unflatten
+        return unflatten(x, self.axis, self._shape)

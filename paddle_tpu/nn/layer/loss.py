@@ -180,3 +180,75 @@ class PoissonNLLLoss(Layer):
     def forward(self, input, label):
         li, fu, ep, re = self.args
         return F.poisson_nll_loss(input, label, li, fu, ep, re)
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean", name=None):
+        super().__init__()
+        self.full, self.epsilon, self.reduction = full, epsilon, reduction
+
+    def forward(self, input, label, variance):
+        return F.gaussian_nll_loss(input, label, variance, self.full,
+                                   self.epsilon, self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.p, self.margin, self.weight, self.reduction = p, margin, weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, self.p, self.margin,
+                                   self.weight, self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.distance_function = distance_function
+        self.margin, self.swap, self.reduction = margin, swap, reduction
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, self.distance_function, self.margin,
+            self.swap, self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """ref loss.py HSigmoidLoss (hierarchical sigmoid)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        from ...core.tensor import Parameter
+        from ...core import generator as _gen
+        import jax
+        import jax.numpy as jnp
+        self.num_classes = num_classes
+        k = _gen.next_key()
+        bound = (6.0 / (num_classes - 1 + feature_size)) ** 0.5
+        self.weight = Parameter(jax.random.uniform(
+            k, (num_classes - 1, feature_size), jnp.float32, -bound, bound))
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = Parameter(jnp.zeros((num_classes - 1,), jnp.float32))
+        self.add_parameter("weight", self.weight)
+        if self.bias is not None:
+            self.add_parameter("bias", self.bias)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table, path_code)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank, self.fastemit_lambda, self.reduction = \
+            blank, fastemit_lambda, reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           self.blank, self.fastemit_lambda, self.reduction)

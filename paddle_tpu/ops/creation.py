@@ -168,3 +168,24 @@ def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
     if default_initializer is not None:
         default_initializer(p)
     return p
+
+
+# ---- breadth additions (reference python/paddle/tensor/creation.py) ----
+
+def vander(x, n=None, increasing=False, name=None):
+    """Vandermonde matrix (ref creation.py vander)."""
+    def f(a):
+        cols = a.shape[0] if n is None else int(n)
+        p = jnp.arange(cols)
+        if not increasing:
+            p = p[::-1]
+        return a[:, None].astype(jnp.promote_types(a.dtype, jnp.float32)) ** p[None]
+    return apply("vander", f, x)
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """ref creation.py create_tensor: an empty typed tensor handle."""
+    from ..core.tensor import Tensor
+    import jax.numpy as _jnp
+    from ..core import dtype as _dtm
+    return Tensor(_jnp.zeros((0,), _dtm.to_np(dtype)))

@@ -231,3 +231,53 @@ def pdist(x, p=2.0, name=None):
         iu = jnp.triu_indices(n, k=1)
         return full[iu]
     return apply("pdist", f, x)
+
+
+# ---- breadth additions (reference python/paddle/tensor/linalg.py) ----
+
+def tensordot(x, y, axes=2, name=None):
+    """ref linalg.py tensordot; axes int or (list, list)."""
+    if isinstance(axes, Tensor):
+        axes = axes.numpy().tolist()
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a.numpy().tolist()) if isinstance(a, Tensor)
+                     else tuple(a) if isinstance(a, (list, tuple)) else (a,)
+                     for a in axes)
+        if len(axes) == 1:
+            axes = (axes[0], axes[0])
+    return apply("tensordot", lambda a, b: jnp.tensordot(a, b, axes=axes), x, y)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack lu()'s packed LU + 1-based pivots into P, L, U (ref lu_unpack)."""
+    def f(lu_, piv):
+        m, n = lu_.shape[-2], lu_.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu_[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_.dtype)
+        U = jnp.triu(lu_[..., :k, :])
+        # pivots (1-based sequential row swaps) -> permutation, batched
+        batch = piv.shape[:-1]
+        perm = jnp.broadcast_to(jnp.arange(m), batch + (m,))
+        for i in range(piv.shape[-1]):
+            j = (piv[..., i] - 1).astype(jnp.int32)[..., None]     # [..., 1]
+            pi = perm[..., i:i + 1]
+            pj = jnp.take_along_axis(perm, j, axis=-1)
+            perm = perm.at[..., i:i + 1].set(pj)
+            perm = jnp.where(
+                jnp.arange(m) == j, pi, perm)                      # scatter at j
+        # P[..., i, c] = 1 iff perm[..., c] == i
+        P = (perm[..., None, :] == jnp.arange(m)[:, None]).astype(lu_.dtype)
+        return P, L, U
+    P, L, U = apply("lu_unpack", f, x, y)
+    return P, L, U
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Low-rank PCA (ref linalg.py pca_lowrank): returns (U, S, V)."""
+    def f(a):
+        m, n = a.shape[-2:]
+        k = q if q is not None else min(6, m, n)
+        c = a - jnp.mean(a, axis=-2, keepdims=True) if center else a
+        u, s, vt = jnp.linalg.svd(c, full_matrices=False)
+        return u[..., :k], s[..., :k], jnp.swapaxes(vt, -1, -2)[..., :k]
+    return apply("pca_lowrank", f, x)

@@ -496,3 +496,45 @@ def is_integer(x):
 
 def rad2deg_(x):
     return x._inplace_from(apply("rad2deg", jnp.rad2deg, x))
+
+
+# ---- breadth additions (reference python/paddle/tensor/manipulation.py) ----
+
+def unflatten(x, axis, shape, name=None):
+    """Split one axis into the given shape (ref manipulation.py unflatten)."""
+    shape = [int(s) for s in shape]
+
+    def f(a):
+        ax = axis % a.ndim
+        new = list(a.shape[:ax]) + shape + list(a.shape[ax + 1:])
+        # resolve a single -1
+        if -1 in shape:
+            known = int(np.prod([s for s in shape if s != -1]))
+            new[new.index(-1, ax)] = a.shape[ax] // known
+        return a.reshape(new)
+    return apply("unflatten", f, x)
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along axis (ref tensor.unfold): returns [..., n, size]."""
+    def f(a):
+        ax = axis % a.ndim
+        n = (a.shape[ax] - size) // step + 1
+        starts = jnp.arange(n) * step
+        idx = starts[:, None] + jnp.arange(size)[None]       # [n, size]
+        win = jnp.take(a, idx.reshape(-1), axis=ax)
+        new = list(a.shape[:ax]) + [n, size] + list(a.shape[ax + 1:])
+        win = win.reshape(new)
+        # windows go to the END like the reference: [..., n, ...] -> [..., n, size]
+        return jnp.moveaxis(win, ax + 1, -1)
+    return apply("unfold", f, x)
+
+
+def vsplit(x, num_or_indices, name=None):
+    """Split along axis 0 (ref manipulation.py vsplit)."""
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def reverse(x, axis, name=None):
+    """Deprecated alias of flip (ref legacy reverse op)."""
+    return flip(x, axis)

@@ -384,3 +384,75 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):
 
 def broadcast_shape(x_shape, y_shape):
     return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+# ---- breadth additions (reference python/paddle/tensor/math.py) ----
+
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+
+
+def logit(x, eps=None, name=None):
+    """ref `tensor/math.py` logit: log(p/(1-p)) with optional eps clamp."""
+    def f(a):
+        p = a if eps is None else jnp.clip(a, eps, 1.0 - eps)
+        return jnp.log(p) - jnp.log1p(-p)
+    return apply("logit", f, x)
+
+
+def add_n(inputs, name=None):
+    """Sum a list of same-shape tensors (ref `sum` op / add_n)."""
+    if isinstance(inputs, Tensor):
+        return inputs
+    return apply("add_n", lambda *ts: functools.reduce(jnp.add, ts), *inputs)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Trapezoidal rule integral (ref tensor/math.py trapezoid)."""
+    if x is not None:
+        return apply("trapezoid", lambda yy, xx: jnp.trapezoid(yy, xx, axis=axis),
+                     y, x)
+    d = 1.0 if dx is None else dx
+    return apply("trapezoid", lambda yy: jnp.trapezoid(yy, dx=d, axis=axis), y)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Cumulative trapezoidal integral (ref tensor/math.py)."""
+    def cum(yy, spacing):
+        a = jnp.moveaxis(yy, axis, -1)
+        avg = (a[..., 1:] + a[..., :-1]) / 2.0
+        seg = avg * spacing
+        return jnp.moveaxis(jnp.cumsum(seg, axis=-1), -1, axis)
+
+    if x is not None:
+        def f(yy, xx):
+            xs = jnp.moveaxis(xx, axis, -1) if xx.ndim == yy.ndim else xx
+            d = jnp.diff(xs, axis=-1) if xs.ndim > 1 or xx.ndim == yy.ndim \
+                else jnp.diff(xs)
+            return cum(yy, d)
+        return apply("cumulative_trapezoid", f, y, x)
+    d = 1.0 if dx is None else dx
+    return apply("cumulative_trapezoid", lambda yy: cum(yy, d), y)
+
+
+def frexp(x, name=None):
+    """Decompose x = m * 2**e with 0.5 <= |m| < 1 (ref tensor/math.py frexp)."""
+    def f(a):
+        zero = a == 0
+        e = jnp.where(zero, 0, jnp.floor(jnp.log2(jnp.abs(jnp.where(zero, 1.0, a)))) + 1)
+        m = jnp.where(zero, 0.0, a / jnp.exp2(e))
+        # normalize edge cases where |m| == 1 (log2 exactness)
+        fix = jnp.abs(m) >= 1.0
+        e = jnp.where(fix, e + 1, e)
+        m = jnp.where(fix, m / 2, m)
+        return m, e.astype(a.dtype)
+    return apply("frexp", f, x)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Renormalize sub-tensors along axis to p-norm <= max_norm (ref renorm op)."""
+    def f(a):
+        red = tuple(i for i in range(a.ndim) if i != (axis % a.ndim))
+        norms = jnp.sum(jnp.abs(a) ** p, axis=red, keepdims=True) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return a * scale
+    return apply("renorm", f, x)

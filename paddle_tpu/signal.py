@@ -1,0 +1,141 @@
+"""paddle.signal — frame / overlap_add / stft / istft.
+
+Reference parity: `python/paddle/signal.py` (frame/overlap_add ops in
+`phi/kernels/frame_kernel.*`, stft composed from frame+matmul FFT).  TPU-native:
+framing is a static-shape gather (XLA-friendly), transforms ride jnp.fft.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .core.tensor import Tensor, apply
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice into overlapping frames (ref signal.py frame).
+
+    x [..., seq_len] (axis=-1) -> [..., frame_length, num_frames], or
+    x [seq_len, ...] (axis=0) -> [num_frames, frame_length, ...].
+    """
+    if frame_length <= 0 or hop_length <= 0:
+        raise ValueError("frame_length and hop_length must be positive")
+
+    def f(a):
+        n = a.shape[axis]
+        if frame_length > n:
+            raise ValueError(f"frame_length {frame_length} > input size {n}")
+        nf = 1 + (n - frame_length) // hop_length
+        starts = jnp.arange(nf) * hop_length
+        if axis in (-1, a.ndim - 1):
+            idx = starts[None, :] + jnp.arange(frame_length)[:, None]  # [fl, nf]
+            return jnp.take(a, idx.reshape(-1), axis=-1).reshape(
+                a.shape[:-1] + (frame_length, nf))
+        idx = starts[:, None] + jnp.arange(frame_length)[None]        # [nf, fl]
+        return jnp.take(a, idx.reshape(-1), axis=0).reshape(
+            (nf, frame_length) + a.shape[1:])
+    return apply("frame", f, x)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame: sum overlapping frames (ref signal.py overlap_add)."""
+    def f(a):
+        if axis in (-1, a.ndim - 1):
+            fl, nf = a.shape[-2], a.shape[-1]
+            out_len = (nf - 1) * hop_length + fl
+            lead = a.shape[:-2]
+            buf = jnp.zeros(lead + (out_len,), a.dtype)
+            pos = (jnp.arange(nf)[None, :] * hop_length +
+                   jnp.arange(fl)[:, None]).reshape(-1)                # [fl*nf]
+            vals = a.reshape(lead + (fl * nf,))
+            return buf.at[..., pos].add(vals)
+        nf, fl = a.shape[0], a.shape[1]
+        out_len = (nf - 1) * hop_length + fl
+        buf = jnp.zeros((out_len,) + a.shape[2:], a.dtype)
+        pos = (jnp.arange(nf)[:, None] * hop_length +
+               jnp.arange(fl)[None]).reshape(-1)
+        vals = a.reshape((nf * fl,) + a.shape[2:])
+        return buf.at[pos].add(vals)
+    return apply("overlap_add", f, x)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    """Short-time Fourier transform (ref signal.py stft).
+
+    x [B, T] or [T] -> complex [B, n_fft//2+1 (or n_fft), num_frames].
+    """
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    wdata = None if window is None else (
+        window._data if isinstance(window, Tensor) else jnp.asarray(window))
+
+    def f(a):
+        squeeze = a.ndim == 1
+        if squeeze:
+            a = a[None]
+        w = jnp.ones((wl,), a.dtype) if wdata is None else wdata
+        # center-pad window to n_fft like the reference
+        if wl < n_fft:
+            lp = (n_fft - wl) // 2
+            w = jnp.pad(w, (lp, n_fft - wl - lp))
+        if center:
+            pad = n_fft // 2
+            a = jnp.pad(a, ((0, 0), (pad, pad)), mode=pad_mode)
+        n = a.shape[-1]
+        nf = 1 + (n - n_fft) // hop
+        idx = (jnp.arange(nf)[None, :] * hop +
+               jnp.arange(n_fft)[:, None]).reshape(-1)
+        frames = jnp.take(a, idx, axis=-1).reshape(a.shape[0], n_fft, nf)
+        frames = frames * w[None, :, None]
+        spec = (jnp.fft.rfft(frames, axis=1) if onesided
+                else jnp.fft.fft(frames, axis=1))
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return spec[0] if squeeze else spec
+    return apply("stft", f, x)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False,
+          name=None):
+    """Inverse STFT with window-envelope normalization (ref signal.py istft)."""
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    wdata = None if window is None else (
+        window._data if isinstance(window, Tensor) else jnp.asarray(window))
+
+    def f(sp):
+        squeeze = sp.ndim == 2
+        if squeeze:
+            sp = sp[None]
+        w = jnp.ones((wl,), jnp.float32) if wdata is None else wdata
+        if wl < n_fft:
+            lp = (n_fft - wl) // 2
+            w = jnp.pad(w, (lp, n_fft - wl - lp))
+        if normalized:
+            sp = sp * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        frames = (jnp.fft.irfft(sp, n=n_fft, axis=1) if onesided
+                  else jnp.fft.ifft(sp, axis=1).real)       # [B, n_fft, nf]
+        frames = frames * w[None, :, None]
+        nf = frames.shape[-1]
+        out_len = (nf - 1) * hop + n_fft
+        pos = (jnp.arange(nf)[None, :] * hop +
+               jnp.arange(n_fft)[:, None]).reshape(-1)
+        buf = jnp.zeros((frames.shape[0], out_len), frames.dtype)
+        buf = buf.at[:, pos].add(frames.reshape(frames.shape[0], -1))
+        env = jnp.zeros((out_len,), frames.dtype)
+        env = env.at[pos].add(jnp.broadcast_to((w * w)[:, None],
+                                               (n_fft, nf)).reshape(-1))
+        buf = buf / jnp.maximum(env, 1e-11)[None]
+        if center:
+            pad = n_fft // 2
+            buf = buf[:, pad:out_len - pad]
+        if length is not None:
+            buf = buf[:, :length]
+        return buf[0] if squeeze else buf
+    return apply("istft", f, x)
+
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]

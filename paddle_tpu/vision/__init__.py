@@ -6,3 +6,13 @@ def set_image_backend(backend):
 
 def get_image_backend():
     return "numpy"
+
+
+def image_load(path, backend=None):
+    """ref vision/image.py image_load."""
+    try:
+        from PIL import Image
+        return Image.open(path)
+    except ImportError:
+        import numpy as np
+        raise RuntimeError("image_load needs PIL (not available in this build)")

@@ -1,0 +1,90 @@
+"""paddle.fft / paddle.signal parity vs numpy (ref python/paddle/fft.py,
+signal.py; op tests test/legacy_test/test_fft.py, test_stft_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+@pytest.fixture(scope="module")
+def x():
+    return np.random.RandomState(0).randn(4, 32).astype(np.float32)
+
+
+def test_fft_matches_numpy(x):
+    for name in ["fft", "ifft", "rfft", "ihfft"]:
+        got = getattr(paddle.fft, name)(paddle.to_tensor(x)).numpy()
+        exp = getattr(np.fft, name)(x)
+        np.testing.assert_allclose(got, exp, atol=1e-4, rtol=1e-4)
+
+
+def test_fft_inverse_roundtrips(x):
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(paddle.fft.irfft(paddle.fft.rfft(t), n=32).numpy(),
+                               x, atol=1e-5)
+    np.testing.assert_allclose(paddle.fft.ifft(paddle.fft.fft(t)).numpy().real,
+                               x, atol=1e-5)
+    np.testing.assert_allclose(
+        paddle.fft.ifftn(paddle.fft.fftn(t)).numpy().real, x, atol=1e-5)
+
+
+def test_fft2_and_shift(x):
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(paddle.fft.fft2(t).numpy(), np.fft.fft2(x),
+                               atol=1e-3, rtol=1e-4)
+    np.testing.assert_allclose(paddle.fft.fftshift(t).numpy(), np.fft.fftshift(x))
+    np.testing.assert_allclose(paddle.fft.fftfreq(8, 0.5).numpy(),
+                               np.fft.fftfreq(8, 0.5), atol=1e-6)
+    np.testing.assert_allclose(paddle.fft.rfftfreq(8).numpy(), np.fft.rfftfreq(8),
+                               atol=1e-6)
+
+
+def test_fft_norm_modes(x):
+    t = paddle.to_tensor(x)
+    for norm in ["backward", "ortho", "forward"]:
+        np.testing.assert_allclose(paddle.fft.fft(t, norm=norm).numpy(),
+                                   np.fft.fft(x, norm=norm), atol=1e-4, rtol=1e-4)
+    with pytest.raises(ValueError):
+        paddle.fft.fft(t, norm="bogus")
+
+
+def test_fft_grad():
+    x = np.random.RandomState(1).randn(16).astype(np.float32)
+    t = paddle.to_tensor(x)
+    t.stop_gradient = False
+    # sum(irfft(rfft(x))) == sum(x) -> grad == ones
+    y = paddle.fft.irfft(paddle.fft.rfft(t), n=16).sum()
+    y.backward()
+    np.testing.assert_allclose(t.grad.numpy(), np.ones(16), atol=1e-4)
+
+
+def test_frame_overlap_add_roundtrip():
+    x = np.arange(16, dtype=np.float32)
+    fr = paddle.signal.frame(paddle.to_tensor(x), 4, 4)  # non-overlapping
+    assert fr.shape == [4, 4]
+    back = paddle.signal.overlap_add(fr, 4)
+    np.testing.assert_allclose(back.numpy(), x)
+    # frame values
+    np.testing.assert_allclose(fr.numpy()[:, 1], x[4:8])
+
+
+def test_stft_matches_manual():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 64).astype(np.float32)
+    n_fft, hop = 16, 8
+    S = paddle.signal.stft(paddle.to_tensor(x), n_fft, hop, center=False).numpy()
+    # manual: frames [n_fft, nf] rfft over axis 0
+    nf = 1 + (64 - n_fft) // hop
+    man = np.stack([np.fft.rfft(x[:, i * hop:i * hop + n_fft], axis=1)
+                    for i in range(nf)], axis=-1)
+    np.testing.assert_allclose(S, man, atol=1e-3, rtol=1e-3)
+
+
+def test_stft_istft_roundtrip():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 128).astype(np.float32)
+    win = np.hanning(32).astype(np.float32)
+    t = paddle.to_tensor(x)
+    S = paddle.signal.stft(t, 32, 8, window=paddle.to_tensor(win))
+    back = paddle.signal.istft(S, 32, 8, window=paddle.to_tensor(win), length=128)
+    np.testing.assert_allclose(back.numpy(), x, atol=1e-3)
