@@ -103,6 +103,7 @@ from . import metric  # noqa
 from . import nn  # noqa
 from . import optimizer  # noqa
 from . import profiler  # noqa
+from . import sparse  # noqa
 from . import static  # noqa
 from . import utils  # noqa
 from . import vision  # noqa
@@ -122,11 +123,13 @@ def in_dynamic_mode():
 def disable_static():
     global _in_dynamic
     _in_dynamic = True
+    static._disable_static_recording()
 
 
 def enable_static():
     global _in_dynamic
     _in_dynamic = False
+    static._enable_static_recording()
 
 
 def disable_signal_handler():
@@ -261,6 +264,22 @@ def _patch_tensor_methods():
     Tensor.matmul = math.matmul
     Tensor.reshape = manipulation.reshape
     Tensor.cast = manipulation.cast
+
+    # sparse conversions (ref Tensor.to_sparse_coo / to_sparse_csr / to_dense)
+    def _to_sparse_coo(self, sparse_dim=None):
+        from .sparse import _dense_to_coo
+        return _dense_to_coo(self, sparse_dim)
+
+    def _to_sparse_csr(self):
+        from .sparse import _dense_to_coo
+        return _dense_to_coo(self).to_sparse_csr()
+
+    Tensor.to_sparse_coo = _to_sparse_coo
+    Tensor.to_sparse_csr = _to_sparse_csr
+    Tensor.to_dense = lambda self: self
+    Tensor.is_sparse = lambda self: False
+    Tensor.is_sparse_coo = lambda self: False
+    Tensor.is_sparse_csr = lambda self: False
 
 
 _patch_tensor_methods()

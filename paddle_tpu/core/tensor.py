@@ -457,6 +457,7 @@ class Tensor:
 
     def set_value(self, value):
         self._data = _to_data(value).astype(self._data.dtype)
+        self._version += 1  # stale tape readers must error, same as copy_
         return self
 
     # value state used by optimizers/Layer
@@ -488,6 +489,13 @@ def _set_amp_state(state):
     _amp_state = state
 
 
+# static-graph recorder slot: when paddle.enable_static() is on, every apply()
+# also appends (name, jfn, inputs, outputs) to the current static Program so
+# Executor.run can re-execute the graph with feed substitution (the TPU-native
+# ProgramDesc: the recorded eager tape IS the program)
+_static_recorder = [None]
+
+
 def apply(name: str, jfn: Callable, *inputs, n_outputs: Optional[int] = None,
           _data_override: Optional[Sequence] = None) -> Any:
     """Single dispatch point for every eager op.
@@ -514,7 +522,10 @@ def apply(name: str, jfn: Callable, *inputs, n_outputs: Optional[int] = None,
 
     if not need_grad:
         out = jfn(*datas)
-        return _wrap_outputs(name, out, node=None)
+        res = _wrap_outputs(name, out, node=None)
+        if _static_recorder[0] is not None:
+            _static_recorder[0]._record(name, jfn, inputs, res)
+        return res
 
     outs, vjp_fn = jax.vjp(jfn, *datas)
     tensor_inputs = [x if isinstance(x, Tensor) else None for x in inputs]
@@ -523,7 +534,10 @@ def apply(name: str, jfn: Callable, *inputs, n_outputs: Optional[int] = None,
     specs = [(o.shape, o.dtype) for o in out_list]
     node = _ag.GradNode(name, vjp_fn, tensor_inputs, len(out_list), specs,
                         jfn=jfn, in_datas=datas, out_tuple=multi)
-    return _wrap_outputs(name, outs, node=node)
+    res = _wrap_outputs(name, outs, node=node)
+    if _static_recorder[0] is not None:
+        _static_recorder[0]._record(name, jfn, inputs, res)
+    return res
 
 
 def _wrap_outputs(name, out, node):

@@ -64,33 +64,40 @@ rfftn = _wrapn(jnp.fft.rfftn, "rfftn")
 irfftn = _wrapn(jnp.fft.irfftn, "irfftn")
 
 
-def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    # jnp.fft lacks hfft2/hfftn; compose: hermitian along last axis, c2c on rest
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """Hermitian n-dim FFT (ref fft.py hfftn): jnp.fft lacks hfftn, so compose
+    the hermitian c2r transform along the last axis with c2c on the rest."""
     nm = _norm(norm)
 
     def f(a):
-        other = tuple(axes[:-1])
+        ax = tuple(range(a.ndim)) if axes is None else tuple(axes)
+        other = ax[:-1]
         out = jnp.fft.ifftn(a, s=None if s is None else s[:-1], axes=other,
                             norm=nm) if other else a
-        return jnp.fft.hfft(out, n=None if s is None else s[-1], axis=axes[-1],
+        return jnp.fft.hfft(out, n=None if s is None else s[-1], axis=ax[-1],
                             norm=nm)
-    return apply("hfft2", f, x)
+    return apply("hfftn", f, x)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    nm = _norm(norm)
+
+    def f(a):
+        ax = tuple(range(a.ndim)) if axes is None else tuple(axes)
+        out = jnp.fft.ihfft(a, n=None if s is None else s[-1], axis=ax[-1],
+                            norm=nm)
+        other = ax[:-1]
+        return jnp.fft.fftn(out, s=None if s is None else s[:-1], axes=other,
+                            norm=nm) if other else out
+    return apply("ihfftn", f, x)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return hfftn(x, s, axes, norm)
 
 
 def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    nm = _norm(norm)
-
-    def f(a):
-        out = jnp.fft.ihfft(a, n=None if s is None else s[-1], axis=axes[-1],
-                            norm=nm)
-        other = tuple(axes[:-1])
-        return jnp.fft.fftn(out, s=None if s is None else s[:-1], axes=other,
-                            norm=nm) if other else out
-    return apply("ihfft2", f, x)
-
-
-hfftn = hfft2
-ihfftn = ihfft2
+    return ihfftn(x, s, axes, norm)
 
 
 def fftfreq(n, d=1.0, dtype=None, name=None):

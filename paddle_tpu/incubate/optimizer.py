@@ -19,8 +19,10 @@ class LookAhead(Optimizer):
         self.alpha = alpha
         self.k = int(k)
         self._step_count = 0
-        self._slow = {}
         self._parameter_list = inner_optimizer._parameter_list
+        # slow weights snapshot the INITIAL params (ref lookahead.py) — seeding
+        # lazily from already-updated fast weights would no-op the first sync
+        self._slow = {id(p): p._data for p in self._parameter_list}
 
     def step(self):
         self.inner_optimizer.step()
@@ -28,8 +30,6 @@ class LookAhead(Optimizer):
         if self._step_count % self.k == 0:
             for p in self._parameter_list:
                 pid = id(p)
-                if pid not in self._slow:
-                    self._slow[pid] = p._data
                 slow = self._slow[pid] + self.alpha * (p._data - self._slow[pid])
                 self._slow[pid] = slow
                 p._data = slow

@@ -2,13 +2,7 @@ from .api import TranslatedLayer, load, not_to_static, save, to_static  # noqa
 from .program import StaticFunction, functionalize  # noqa
 
 
-_to_static_enabled = True
-
-
-def enable_to_static(enable=True):
-    """ref jit/api.py enable_to_static: global switch for @to_static capture."""
-    global _to_static_enabled
-    _to_static_enabled = bool(enable)
+from .api import enable_to_static  # noqa
 
 
 def ignore_module(modules):

@@ -20,10 +20,21 @@ from ..static.input_spec import InputSpec
 from .program import StaticFunction, functionalize
 
 
+_to_static_enabled = [True]
+
+
+def enable_to_static(enable=True):
+    """ref jit/api.py enable_to_static: global switch — when off, @to_static
+    functions run eagerly (debugging escape hatch)."""
+    _to_static_enabled[0] = bool(enable)
+
+
 def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
               **kwargs):
     """Decorator/wrapper converting a dygraph function or Layer to a compiled program."""
     def decorate(fn):
+        if not _to_static_enabled[0]:
+            return fn  # capture disabled: dygraph passthrough
         if isinstance(fn, Layer):
             static = StaticFunction(fn, input_spec)
             fn.forward = static

@@ -110,13 +110,17 @@ def dynamic_decode(decoder, inits=None, max_step_num=100, output_time_major=Fals
     tokens, state = decoder.initialize(inits)
     outs = []
     lengths = None
+    done = None
     for t in range(max_step_num):
         tokens, state, finished = decoder.step(t, tokens, state)
         outs.append(tokens)
         if lengths is None:
             lengths = jnp.full(finished.shape, t + 1, jnp.int64)
+            done = finished
         else:
-            lengths = jnp.where(finished & (lengths == t), lengths, t + 1)
+            # beams not yet done extend to the current step; done beams freeze
+            lengths = jnp.where(done, lengths, t + 1)
+            done = done | finished
         if bool(jnp.all(finished)):
             break
     stacked = jnp.stack(outs, axis=0 if output_time_major else 1)

@@ -124,6 +124,19 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..core.tensor import _static_recorder
+        if _static_recorder[0] is not None:
+            # static mode (ref static minimize appends backward + update ops to
+            # the Program): record the train step as a program op executed by
+            # Executor.run, instead of running it at build time
+            opt = self
+
+            def train_op():
+                loss.backward(retain_graph=True)
+                opt.step()
+                opt.clear_grad()
+            _static_recorder[0]._record_py(train_op)
+            return None, None
         # skip backward when an explicit loss.backward() already ran (directly
         # tracked, so retain_graph=True doesn't double-accumulate grads) —
         # reference minimize only collects existing grads in that pattern

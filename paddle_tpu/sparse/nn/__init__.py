@@ -1,0 +1,201 @@
+"""paddle.sparse.nn — layers over sparse tensors.
+
+Reference parity: `python/paddle/sparse/nn/` (layer/activation.py, conv.py,
+norm.py, pooling.py; kernels `phi/kernels/sparse/`).
+
+TPU-native stance: activations/norms act on the explicit values (structure
+preserved).  Sparse/submanifold convolutions densify the voxel grid and run
+XLA's dense conv on the MXU, then re-sparsify — at the occupancies this API is
+used for on TPU, dense conv with masking beats gather/scatter conv; submanifold
+semantics (output pattern == input pattern) are preserved exactly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply
+from ...nn.layer.layers import Layer
+from .. import SparseCooTensor, SparseCsrTensor, _dense_to_coo, _is_sparse
+from . import functional  # noqa
+
+__all__ = ["BatchNorm", "Conv2D", "Conv3D", "LeakyReLU", "MaxPool3D", "ReLU",
+           "ReLU6", "Softmax", "SubmConv2D", "SubmConv3D", "SyncBatchNorm"]
+
+
+class _ValueActivation(Layer):
+    _fn = None
+    _name = "act"
+
+    def forward(self, x):
+        if _is_sparse(x):
+            vals = apply(self._name, type(self)._fn, x.values())
+            if isinstance(x, SparseCooTensor):
+                return SparseCooTensor(x._indices, vals, x._shape, x._coalesced)
+            return SparseCsrTensor(x._crows, x._cols, vals, x._shape)
+        return apply(self._name, type(self)._fn, x)
+
+
+class ReLU(_ValueActivation):
+    _fn = staticmethod(jax.nn.relu)
+    _name = "sparse_relu"
+
+
+class ReLU6(_ValueActivation):
+    _fn = staticmethod(lambda v: jnp.clip(v, 0.0, 6.0))
+    _name = "sparse_relu6"
+
+
+class LeakyReLU(_ValueActivation):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        slope = self.negative_slope
+        fn = lambda v: jnp.where(v >= 0, v, slope * v)  # noqa: E731
+        if _is_sparse(x):
+            vals = apply("sparse_leaky_relu", fn, x.values())
+            if isinstance(x, SparseCooTensor):
+                return SparseCooTensor(x._indices, vals, x._shape, x._coalesced)
+            return SparseCsrTensor(x._crows, x._cols, vals, x._shape)
+        return apply("sparse_leaky_relu", fn, x)
+
+
+class Softmax(Layer):
+    """CSR row-wise softmax over explicit values (ref sparse softmax)."""
+
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        assert axis == -1, "sparse softmax supports the last axis"
+
+    def forward(self, x):
+        if isinstance(x, SparseCsrTensor):
+            rows = x._row_ids().astype(jnp.int32)
+            n = x._shape[0]
+
+            def f(v):
+                mx = jax.ops.segment_max(v, rows, num_segments=n)
+                e = jnp.exp(v - mx[rows])
+                s = jax.ops.segment_sum(e, rows, num_segments=n)
+                return e / s[rows]
+            vals = apply("sparse_softmax", f, x.values())
+            return SparseCsrTensor(x._crows, x._cols, vals, x._shape)
+        if isinstance(x, SparseCooTensor):
+            return Softmax()(x.to_sparse_csr())
+        from ...nn.functional.activation import softmax as dsoftmax
+        return dsoftmax(x, axis=-1)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the channel (last) dim of COO values (ref sparse norm)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        from ...nn.layer.norm import BatchNorm1D
+        self._bn = BatchNorm1D(num_features, momentum=momentum, epsilon=epsilon)
+
+    def forward(self, x):
+        if _is_sparse(x):
+            vals = self._bn(x.values())
+            return SparseCooTensor(x._indices, vals, x._shape, x._coalesced)
+        return self._bn(x)
+
+
+SyncBatchNorm = BatchNorm
+
+
+def _dense_conv_sparse(x, weight, bias, stride, padding, dims, subm):
+    """Densify -> XLA conv -> re-sparsify (see module docstring)."""
+    from ...nn.functional.conv import conv2d, conv3d
+    dense = x.to_dense()                     # [N, *spatial, C] (NDHWC/NHWC)
+    perm_in = (0, dims + 1) + tuple(range(1, dims + 1))       # -> NC...
+    from ...ops.manipulation import transpose as tr
+    xc = tr(dense, list(perm_in))
+    conv = conv2d if dims == 2 else conv3d
+    out = conv(xc, weight, bias, stride=stride, padding=padding)
+    back = (0,) + tuple(range(2, dims + 2)) + (1,)            # -> N...C
+    out = tr(out, list(back))
+    if subm:
+        # submanifold: output pattern == input pattern
+        idx = x._indices
+        sd = idx.shape[0]
+        vals = apply("subm_gather", lambda a: a[tuple(idx[i] for i in range(sd))],
+                     out)
+        out_shape = tuple(out.shape)
+        return SparseCooTensor(idx, vals, out_shape, x._coalesced)
+    return _dense_to_coo(out, sparse_dim=dims + 1)
+
+
+class _SparseConv(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, subm=False, dims=3,
+                 weight_attr=None, bias_attr=None, data_format=None, name=None):
+        super().__init__()
+        from ...core.tensor import Parameter
+        from ...core import generator as _gen
+        ks = (kernel_size,) * dims if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        fan_in = in_channels
+        for k in ks:
+            fan_in *= k
+        bound = (6.0 / fan_in) ** 0.5
+        self.weight = Parameter(jax.random.uniform(
+            _gen.next_key(), (out_channels, in_channels) + ks, jnp.float32,
+            -bound, bound))
+        self.add_parameter("weight", self.weight)
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = Parameter(jnp.zeros((out_channels,), jnp.float32))
+            self.add_parameter("bias", self.bias)
+        self._stride, self._padding = stride, padding
+        self._subm, self._dims = subm, dims
+
+    def forward(self, x):
+        return _dense_conv_sparse(x, self.weight, self.bias, self._stride,
+                                  self._padding, self._dims, self._subm)
+
+
+class Conv2D(_SparseConv):
+    def __init__(self, in_channels, out_channels, kernel_size, **kw):
+        kw.setdefault("dims", 2)
+        super().__init__(in_channels, out_channels, kernel_size, **kw)
+
+
+class Conv3D(_SparseConv):
+    def __init__(self, in_channels, out_channels, kernel_size, **kw):
+        kw.setdefault("dims", 3)
+        super().__init__(in_channels, out_channels, kernel_size, **kw)
+
+
+class SubmConv2D(_SparseConv):
+    def __init__(self, in_channels, out_channels, kernel_size, **kw):
+        kw.setdefault("dims", 2)
+        kw["subm"] = True
+        super().__init__(in_channels, out_channels, kernel_size, **kw)
+
+
+class SubmConv3D(_SparseConv):
+    def __init__(self, in_channels, out_channels, kernel_size, **kw):
+        kw.setdefault("dims", 3)
+        kw["subm"] = True
+        super().__init__(in_channels, out_channels, kernel_size, **kw)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding)
+
+    def forward(self, x):
+        from ...nn.functional.pooling import max_pool3d
+        from ...ops.manipulation import transpose as tr
+        k, s, p = self._args
+        dense = x.to_dense() if _is_sparse(x) else x      # NDHWC
+        xc = tr(dense, [0, 4, 1, 2, 3])
+        out = max_pool3d(xc, k, s if s is not None else k, p)
+        out = tr(out, [0, 2, 3, 4, 1])
+        return _dense_to_coo(out, sparse_dim=4) if _is_sparse(x) else out

@@ -1,0 +1,88 @@
+"""paddle.sparse.nn.functional (ref python/paddle/sparse/nn/functional/)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor, apply
+from ... import SparseCooTensor, SparseCsrTensor, _is_sparse
+
+__all__ = ["attention", "conv2d", "conv3d", "leaky_relu", "max_pool3d", "relu",
+           "relu6", "softmax", "subm_conv2d", "subm_conv3d"]
+
+
+def _value_op(name, fn, x):
+    if _is_sparse(x):
+        vals = apply(name, fn, x.values())
+        if isinstance(x, SparseCooTensor):
+            return SparseCooTensor(x._indices, vals, x._shape, x._coalesced)
+        return SparseCsrTensor(x._crows, x._cols, vals, x._shape)
+    return apply(name, fn, x)
+
+
+def relu(x, name=None):
+    return _value_op("sparse_relu", jax.nn.relu, x)
+
+
+def relu6(x, name=None):
+    return _value_op("sparse_relu6", lambda v: jnp.clip(v, 0.0, 6.0), x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _value_op("sparse_leaky_relu",
+                     lambda v: jnp.where(v >= 0, v, negative_slope * v), x)
+
+
+def softmax(x, axis=-1, name=None):
+    from .. import Softmax
+    return Softmax(axis)(x)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NHWC", name=None):
+    from .. import _dense_conv_sparse
+    return _dense_conv_sparse_w(x, weight, bias, stride, padding, 2, False)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    return _dense_conv_sparse_w(x, weight, bias, stride, padding, 3, False)
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    return _dense_conv_sparse_w(x, weight, bias, stride, padding, 2, True)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    return _dense_conv_sparse_w(x, weight, bias, stride, padding, 3, True)
+
+
+def _dense_conv_sparse_w(x, weight, bias, stride, padding, dims, subm):
+    """Functional form: reference weights are [*ks, in, out]; the layer path
+    stores [out, in, *ks] — detect and adapt."""
+    from .. import _dense_conv_sparse
+    from ....ops.manipulation import transpose as tr
+    w = weight
+    wd = w._data if isinstance(w, Tensor) else jnp.asarray(w)
+    if wd.ndim == dims + 2 and wd.shape[-1] != wd.shape[0]:
+        # heuristic: reference layout [*ks, Cin, Cout] -> [Cout, Cin, *ks]
+        perm = [dims + 1, dims] + list(range(dims))
+        w = tr(w, perm) if isinstance(w, Tensor) else Tensor(jnp.transpose(wd, perm))
+    return _dense_conv_sparse(x, w, bias, stride, padding, dims, subm)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NDHWC", name=None):
+    from .. import MaxPool3D
+    return MaxPool3D(kernel_size, stride, padding)(x)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """ref sparse/nn/functional/transformer.py attention: softmax(QK^T/sqrt(d))
+    restricted to sparse_mask's CSR pattern, times V."""
+    from ....nn.functional.sparse_ops import sparse_attention
+    return sparse_attention(query, key, value, sparse_mask.crows(),
+                            sparse_mask.cols())

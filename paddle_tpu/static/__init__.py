@@ -1,49 +1,606 @@
-"""paddle.static compatibility surface.
+"""paddle.static — the static-graph surface, executable.
 
-The reference's static graph (ProgramDesc + executors) maps to jit/to_static capture
-here; this module keeps the high-traffic static APIs importable: InputSpec, save/load
-inference model (delegating to jit.save/load), and name-scoped data declarations.
+Reference parity: `python/paddle/static/` (Program/Executor over ProgramDesc,
+`fluid/framework/program_desc.h:32`, `new_executor/standalone_executor.h:34`).
+
+TPU-native design: there is no ProgramDesc protobuf — under
+`paddle.enable_static()` every eager op dispatch additionally records
+(name, jfn, inputs, outputs) into the current `Program` (see
+`core/tensor.py:_static_recorder`).  `Executor.run` re-executes the recorded op
+list with feed values substituted into the placeholder tensors and rebinds each
+recorded output, so parameters persist across `run` calls and
+`Optimizer.minimize` (recorded as a train-op closure) updates them — the
+standalone-executor behavior with the tape as the program IR.
 """
 from __future__ import annotations
 
+import contextlib
+import pickle
+from typing import Any, Dict, List
+
+import numpy as np
+
 from .input_spec import InputSpec  # noqa
+from ..core.tensor import Tensor, _static_recorder, _to_data
 
 
-def data(name, shape, dtype="float32", lod_level=0):
-    from ..core.tensor import Tensor
-    import jax.numpy as jnp
-    from ..core import dtype as _dt
-    import numpy as np
-    shp = [1 if (s is None or s == -1) else s for s in shape]
-    t = Tensor(jnp.zeros(shp, _dt.to_np(dtype)))
-    t.name = name
-    return t
-
-
-def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, **kwargs):
-    raise NotImplementedError(
-        "static-graph save_inference_model: use paddle_tpu.jit.save on a Layer (the "
-        "to_static capture path replaces ProgramDesc serialization)")
-
-
-def load_inference_model(path_prefix, executor=None, **kwargs):
-    from ..jit import load
-    return load(path_prefix)
+class Variable(Tensor):
+    """Alias: static Variables are Tensors here (ref framework.Variable)."""
 
 
 class Program:
-    """Placeholder Program object for API compat (the jaxpr is the real IR)."""
+    """Recorded op list + placeholder registry (ref ProgramDesc)."""
 
     def __init__(self):
-        self._ops = []
+        self.ops: List[Any] = []          # ("op", name, jfn, inputs, outputs)
+                                          # | ("py", fn)
+        self.placeholders: Dict[str, Tensor] = {}
+        self.params: List[Tensor] = []
+        self.random_seed = 0
 
+    # -- recorder hooks --
+    def _record(self, name, jfn, inputs, res):
+        outs = res if isinstance(res, tuple) else (res,)
+        self.ops.append(("op", name, jfn, list(inputs), list(outs)))
+
+    def _record_py(self, fn):
+        self.ops.append(("py", fn))
+
+    # -- ProgramDesc-surface compat --
     def global_block(self):
         return self
 
+    def clone(self, for_test=False):
+        if not for_test:
+            return self
+        p = Program()
+        # test clone: drop train-ops (backward/optimizer closures)
+        p.ops = [op for op in self.ops if op[0] == "op"]
+        p.placeholders = self.placeholders
+        p.params = self.params
+        return p
+
+    def list_vars(self):
+        return list(self.placeholders.values()) + list(self.params)
+
+    def all_parameters(self):
+        return list(self.params)
+
+    @property
+    def blocks(self):
+        return [self]
+
+
+_main_program = Program()
+_startup_program = Program()
+
 
 def default_main_program():
-    return Program()
+    return _main_program
 
 
 def default_startup_program():
-    return Program()
+    return _startup_program
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _main_program, _startup_program
+    prev_m, prev_s = _main_program, _startup_program
+    prev_rec = _static_recorder[0]
+    _main_program = main_program
+    if startup_program is not None:
+        _startup_program = startup_program
+    if prev_rec is not None:          # static mode on: record into the guard's
+        _static_recorder[0] = main_program
+    try:
+        yield
+    finally:
+        _main_program = prev_m
+        _startup_program = prev_s
+        _static_recorder[0] = prev_rec
+
+
+def _enable_static_recording():
+    _static_recorder[0] = _main_program
+
+
+def _disable_static_recording():
+    _static_recorder[0] = None
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Declare a feed placeholder (ref static.data)."""
+    import jax.numpy as jnp
+    from ..core import dtype as _dt
+    shp = [1 if (s is None or s == -1) else s for s in shape]
+    t = Tensor(jnp.zeros(shp, _dt.to_np(dtype)))
+    t.name = name
+    if dtype in ("float32", "float64", "float16", "bfloat16"):
+        t.stop_gradient = False
+    _main_program.placeholders[name] = t
+    return t
+
+
+class Scope:
+    """Name -> variable map (ref framework.Scope)."""
+
+    def __init__(self):
+        self.vars: Dict[str, Tensor] = {}
+
+    def var(self, name):
+        return self.vars.setdefault(name, Tensor())
+
+    def find_var(self, name):
+        v = self.vars.get(name)
+        if v is None:
+            v = _main_program.placeholders.get(name)
+        if v is None:
+            for p in _main_program.params:
+                if getattr(p, "name", None) == name:
+                    return _VarWrap(p)
+        return _VarWrap(v) if v is not None else None
+
+
+class _VarWrap:
+    def __init__(self, t):
+        self._t = t
+
+    def get_tensor(self):
+        return np.asarray(self._t._data)
+
+
+_scope = Scope()
+
+
+def global_scope():
+    return _scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _scope
+    prev = _scope
+    _scope = scope
+    try:
+        yield
+    finally:
+        _scope = prev
+
+
+class Executor:
+    """Re-executes a recorded Program (ref StandaloneExecutor)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True, **kwargs):
+        import jax.numpy as jnp
+        prog = program or _main_program
+        if isinstance(prog, CompiledProgram):
+            prog = prog._program
+        if isinstance(prog, _LoadedProgram):
+            args = [jnp.asarray(_to_data((feed or {})[n]))
+                    for n in prog.feed_names]
+            outs = prog.exported.call(*args)
+            outs = outs if isinstance(outs, (tuple, list)) else (outs,)
+            return [np.asarray(o) if return_numpy else Tensor(o) for o in outs]
+        # executing must not re-record
+        prev = _static_recorder[0]
+        _static_recorder[0] = None
+        try:
+            for name, val in (feed or {}).items():
+                ph = prog.placeholders.get(name)
+                if ph is None:
+                    ph = _main_program.placeholders.get(name)
+                if ph is None:
+                    raise KeyError(f"feed target '{name}' is not a declared "
+                                   "static.data placeholder")
+                ph._data = jnp.asarray(_to_data(val))
+                ph.grad = None   # feed grads never persist across runs
+            from ..core.tensor import apply
+            for op in prog.ops:
+                if op[0] == "py":
+                    op[1]()
+                    continue
+                _, name, jfn, inputs, outputs = op
+                res = apply(name, jfn, *inputs)
+                outs = res if isinstance(res, tuple) else (res,)
+                for t, o in zip(outputs, outs):
+                    t._data = o._data
+                    t._grad_node = o._grad_node
+                    t._out_index = o._out_index
+            if fetch_list is None:
+                return []
+            out = []
+            for t in fetch_list:
+                out.append(np.asarray(t._data) if return_numpy else t)
+            return out
+        finally:
+            _static_recorder[0] = prev
+
+    def close(self):
+        pass
+
+
+class CompiledProgram:
+    """ref CompiledProgram: XLA jit-compiles each re-executed op anyway, so this
+    is a thin marker around Program."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+
+    def with_data_parallel(self, *a, **kw):
+        return self
+
+
+class BuildStrategy:
+    def __init__(self):
+        self.memory_optimize = None
+        self.enable_inplace = None
+        self.fuse_all_optimizer_ops = False
+        self.fuse_elewise_add_act_ops = False
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+class IpuStrategy:
+    def __init__(self):
+        pass
+
+
+class IpuCompiledProgram:
+    def __init__(self, program=None, ipu_strategy=None, scope=None):
+        self._program = program
+
+    def compile(self, feed_list, fetch_list):
+        return self._program
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    yield
+
+
+@contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    yield
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    return call_func
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_layout=True, print_tensor_lod=True,
+          print_phase="both"):
+    """ref static Print op: logs at execution, passes the value through."""
+    from ..core.tensor import apply
+    import jax
+
+    def f(x):
+        jax.debug.print((message or "") + " {}", x)
+        return x
+    return apply("print", f, input)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """ref static py_func: host-python op."""
+    from ..core.tensor import apply
+    import jax.numpy as jnp
+    xs = x if isinstance(x, (list, tuple)) else [x]
+
+    def f(*datas):
+        res = func(*[np.asarray(d) for d in datas])
+        return jnp.asarray(res)
+    return apply("py_func", f, *xs)
+
+
+def create_global_var(shape, value, dtype, persistable=False, force_cpu=False,
+                      name=None):
+    import jax.numpy as jnp
+    from ..core import dtype as _dt
+    t = Tensor(jnp.full(shape, value, _dt.to_np(dtype)))
+    t.persistable = persistable
+    if name:
+        t.name = name
+    _main_program.params.append(t)
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    import jax
+    import jax.numpy as jnp
+    from ..core import dtype as _dt, generator as _gen
+    from ..ops.creation import create_parameter as _create
+    if default_initializer is None and not is_bias:
+        # static default: fan-in uniform (the eager helper defaults to zeros)
+        fan_in = shape[0] if shape else 1
+        bound = (6.0 / max(fan_in, 1)) ** 0.5
+        key = _gen.next_key()
+        default_initializer = lambda t: t.set_value(  # noqa: E731
+            jax.random.uniform(key, tuple(shape), _dt.to_np(dtype),
+                               -bound, bound))
+    p = _create(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+                default_initializer=default_initializer)
+    p.stop_gradient = False
+    if name:
+        p.name = name
+    _main_program.params.append(p)
+    return p
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """ref static gradients: grads of targets w.r.t. inputs."""
+    from ..core.autograd import grad as _grad
+    ts = targets if isinstance(targets, (list, tuple)) else [targets]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    gs = _grad(ts, ins, grad_outputs=target_gradients, retain_graph=True,
+               allow_unused=True)
+    return list(gs)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None,
+                    checkpoints=None):
+    """ref append_backward: records the backward as a train op; grads land on
+    param.grad after the next Executor.run."""
+    params = parameter_list or _main_program.params
+
+    def run_backward():
+        loss.backward(retain_graph=True)
+    _main_program._record_py(run_backward)
+    return [(p, p.grad) for p in params]
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    from ..ops.math import accuracy as _acc
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=200, topk=1, slide_steps=1):
+    import jax.numpy as jnp
+    from ..metric import Auc
+    m = Auc(num_thresholds=num_thresholds)
+    m.update(np.asarray(input._data), np.asarray(label._data))
+    return Tensor(jnp.asarray(m.accumulate(), jnp.float32))
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    raise NotImplementedError(
+        "ctr_metric_bundle belongs to the parameter-server mode, which is "
+        "descoped on TPU (see README scope notes)")
+
+
+class ExponentialMovingAverage:
+    """ref static ExponentialMovingAverage over parameters."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._ema: Dict[int, Any] = {}
+        self._backup: Dict[int, Any] = {}
+        self._step = 0
+
+    def update(self):
+        self._step += 1
+        for p in _main_program.params:
+            pid = id(p)
+            prev = self._ema.get(pid, p._data)
+            self._ema[pid] = self._decay * prev + (1 - self._decay) * p._data
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        self._backup = {id(p): p._data for p in _main_program.params}
+        for p in _main_program.params:
+            if id(p) in self._ema:
+                p._data = self._ema[id(p)]
+        try:
+            yield
+        finally:
+            if need_restore:
+                for p in _main_program.params:
+                    p._data = self._backup[id(p)]
+
+    def restore(self, executor=None):
+        for p in _main_program.params:
+            if id(p) in self._backup:
+                p._data = self._backup[id(p)]
+
+
+class WeightNormParamAttr:
+    """ref WeightNormParamAttr (compat shell; weight-norm lives in
+    nn.utils on the eager path)."""
+
+    def __init__(self, dim=None, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=False,
+                 need_clip=True):
+        self.dim = dim
+        self.name = name
+
+
+def cpu_places(device_count=None):
+    from ..core.place import CPUPlace
+    return [CPUPlace() for _ in range(device_count or 1)]
+
+
+def cuda_places(device_ids=None):
+    from ..core.place import CUDAPlace
+    ids = device_ids if device_ids is not None else [0]
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    from ..core.place import XPUPlace
+    ids = device_ids if device_ids is not None else [0]
+    return [XPUPlace(i) for i in ids]
+
+
+# ---- serialization (ref static/io.py) ----
+
+def _state(program):
+    return {getattr(p, "name", f"param_{i}"): np.asarray(p._data)
+            for i, p in enumerate(program.params)}
+
+
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    feeds = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    fetches = fetch_vars if isinstance(fetch_vars, (list, tuple)) else [fetch_vars]
+    return pickle.dumps({"feeds": [t.name for t in feeds],
+                         "fetch_shapes": [list(t.shape) for t in fetches]})
+
+
+def serialize_persistables(feed_vars, fetch_vars, **kwargs):
+    return pickle.dumps(_state(_main_program))
+
+
+def deserialize_program(data):
+    return pickle.loads(data)
+
+
+def deserialize_persistables(program, data, executor=None):
+    import jax.numpy as jnp
+    state = pickle.loads(data)
+    params = program.params if isinstance(program, Program) \
+        else _main_program.params
+    for i, p in enumerate(params):
+        name = getattr(p, "name", f"param_{i}")
+        if name in state:
+            p._data = jnp.asarray(state[name])
+    return state
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def save(program, model_path, protocol=4, **configs):
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(_state(program), f, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    deserialize_persistables(program, pickle.dumps(state))
+
+
+def load_program_state(model_path, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state_dict):
+    deserialize_persistables(program, pickle.dumps(state_dict))
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    return program
+
+
+_inference_registry: Dict[str, Any] = {}
+
+
+def _make_replay_fn(prog, feeds, fetches):
+    """Functional interpreter over the recorded op list: feed arrays in,
+    fetch arrays out.  Params and constants are closed over, so jax can trace
+    and export it as one StableHLO program."""
+    def fn(*feed_datas):
+        env = {id(ph): d for ph, d in zip(feeds, feed_datas)}
+        for op in prog.ops:
+            if op[0] != "op":
+                continue                  # train ops are not part of inference
+            _, name, jfn, inputs, outputs = op
+            datas = [env.get(id(x), x._data if isinstance(x, Tensor) else x)
+                     for x in inputs]
+            out = jfn(*datas)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            for t, o in zip(outputs, outs):
+                env[id(t)] = o
+        return tuple(env.get(id(f), f._data) for f in fetches)
+    return fn
+
+
+class _LoadedProgram:
+    """Deserialized inference program: Executor.run calls the compiled
+    StableHLO artifact directly."""
+
+    def __init__(self, exported, feed_names):
+        self.exported = exported
+        self.feed_names = feed_names
+        self.placeholders: Dict[str, Tensor] = {}
+        self.params: List[Tensor] = []
+        self.ops: List[Any] = []
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Export the inference slice of the program as StableHLO (params baked in)
+    so `load_inference_model` works across processes (ref
+    save_inference_model -> ProgramDesc+persistables serialization)."""
+    import os
+    import jax
+    from jax import export as jax_export
+    prog = program or _main_program
+    feeds = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    fetches = fetch_vars if isinstance(fetch_vars, (list, tuple)) else [fetch_vars]
+    fn = _make_replay_fn(prog, feeds, fetches)
+    specs = [jax.ShapeDtypeStruct(tuple(t._data.shape), t._data.dtype)
+             for t in feeds]
+    exported = jax_export.export(jax.jit(fn))(*specs)
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump({"params": _state(prog),
+                     "feed_names": [t.name for t in feeds]}, f)
+    _inference_registry[path_prefix] = (prog, feeds, fetches)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    from jax import export as jax_export
+    with open(path_prefix + ".pdiparams", "rb") as f:
+        payload = pickle.load(f)
+    if path_prefix in _inference_registry:
+        # same-process fast path: rehydrate the live program's params
+        prog, feeds, fetches = _inference_registry[path_prefix]
+        deserialize_persistables(prog, pickle.dumps(payload["params"]))
+        return prog, payload["feed_names"], fetches
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    prog = _LoadedProgram(exported, payload["feed_names"])
+    n_out = len(exported.out_avals)
+    return prog, payload["feed_names"], list(range(n_out))
+
+
+__all__ = [
+    "BuildStrategy", "CompiledProgram", "ExecutionStrategy", "Executor",
+    "ExponentialMovingAverage", "InputSpec", "IpuCompiledProgram", "IpuStrategy",
+    "Print", "Program", "Variable", "WeightNormParamAttr", "accuracy",
+    "append_backward", "auc", "cpu_places", "create_global_var",
+    "create_parameter", "ctr_metric_bundle", "cuda_places", "data",
+    "default_main_program", "default_startup_program",
+    "deserialize_persistables", "deserialize_program", "device_guard",
+    "global_scope", "gradients", "ipu_shard_guard", "load", "load_from_file",
+    "load_inference_model", "load_program_state", "name_scope",
+    "normalize_program", "program_guard", "py_func", "save",
+    "save_inference_model", "save_to_file", "scope_guard",
+    "serialize_persistables", "serialize_program", "set_ipu_shard",
+    "set_program_state", "xpu_places",
+]

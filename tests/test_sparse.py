@@ -1,0 +1,159 @@
+"""paddle.sparse parity (ref python/paddle/sparse/ + test/legacy_test sparse
+op tests): COO/CSR creation, conversions, elementwise, matmul family,
+autograd through values, and sparse.nn layers."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as sparse
+
+
+def _coo():
+    indices = np.array([[0, 1, 2], [1, 2, 0]], np.int64)
+    values = np.array([1.0, 2.0, 3.0], np.float32)
+    return sparse.sparse_coo_tensor(indices, values, [3, 3])
+
+
+def test_coo_create_and_to_dense():
+    s = _coo()
+    assert s.is_sparse_coo() and s.nnz() == 3
+    dense = s.to_dense().numpy()
+    exp = np.zeros((3, 3), np.float32)
+    exp[0, 1], exp[1, 2], exp[2, 0] = 1, 2, 3
+    np.testing.assert_allclose(dense, exp)
+
+
+def test_csr_create_and_roundtrip():
+    crows = np.array([0, 2, 3, 5], np.int64)
+    cols = np.array([0, 2, 1, 0, 2], np.int64)
+    vals = np.array([1., 2., 3., 4., 5.], np.float32)
+    s = sparse.sparse_csr_tensor(crows, cols, vals, [3, 3])
+    exp = np.array([[1, 0, 2], [0, 3, 0], [4, 0, 5]], np.float32)
+    np.testing.assert_allclose(s.to_dense().numpy(), exp)
+    coo = s.to_sparse_coo()
+    np.testing.assert_allclose(coo.to_dense().numpy(), exp)
+    back = coo.to_sparse_csr()
+    np.testing.assert_allclose(back.to_dense().numpy(), exp)
+    np.testing.assert_array_equal(back.crows().numpy(), crows)
+
+
+def test_dense_tensor_to_sparse_methods():
+    d = paddle.to_tensor(np.array([[0., 5.], [7., 0.]], np.float32))
+    coo = d.to_sparse_coo()
+    assert coo.nnz() == 2
+    np.testing.assert_allclose(coo.to_dense().numpy(), d.numpy())
+    csr = d.to_sparse_csr()
+    np.testing.assert_allclose(csr.to_dense().numpy(), d.numpy())
+
+
+def test_coalesce_merges_duplicates():
+    indices = np.array([[0, 0, 1], [1, 1, 0]], np.int64)
+    s = sparse.sparse_coo_tensor(indices, np.array([1., 2., 3.], np.float32),
+                                 [2, 2])
+    c = sparse.coalesce(s)
+    assert c.nnz() == 2
+    np.testing.assert_allclose(c.to_dense().numpy(), [[0, 3], [3, 0]])
+
+
+def test_unary_preserves_structure():
+    s = _coo()
+    out = sparse.square(s)
+    assert out.is_sparse_coo() and out.nnz() == 3
+    np.testing.assert_allclose(out.values().numpy(), [1., 4., 9.])
+    np.testing.assert_allclose(sparse.neg(s).values().numpy(), [-1., -2., -3.])
+
+
+def test_binary_same_pattern():
+    a, b = _coo(), _coo()
+    out = sparse.add(a, b)
+    np.testing.assert_allclose(out.values().numpy(), [2., 4., 6.])
+    m = sparse.multiply(a, b)
+    np.testing.assert_allclose(m.values().numpy(), [1., 4., 9.])
+
+
+def test_sparse_matmul_and_mv():
+    s = _coo()
+    d = np.arange(9, dtype=np.float32).reshape(3, 3)
+    out = sparse.matmul(s, paddle.to_tensor(d))
+    np.testing.assert_allclose(out.numpy(), s.numpy() @ d, atol=1e-5)
+    v = np.array([1., 2., 3.], np.float32)
+    mv = sparse.mv(s, paddle.to_tensor(v))
+    np.testing.assert_allclose(mv.numpy(), s.numpy() @ v, atol=1e-5)
+
+
+def test_masked_matmul_sddmm():
+    rng = np.random.RandomState(0)
+    a = rng.randn(3, 4).astype(np.float32)
+    b = rng.randn(4, 3).astype(np.float32)
+    mask = _coo()
+    out = sparse.masked_matmul(paddle.to_tensor(a), paddle.to_tensor(b), mask)
+    assert out.is_sparse_coo()
+    full = a @ b
+    exp = np.array([full[0, 1], full[1, 2], full[2, 0]])
+    np.testing.assert_allclose(out.values().numpy(), exp, atol=1e-5)
+
+
+def test_grad_flows_through_sparse_values():
+    indices = np.array([[0, 1], [1, 0]], np.int64)
+    vals = paddle.to_tensor(np.array([2.0, 3.0], np.float32))
+    vals.stop_gradient = False
+    s = sparse.SparseCooTensor(indices, vals, (2, 2))
+    d = np.ones((2, 2), np.float32)
+    out = sparse.matmul(s, paddle.to_tensor(d))
+    out.sum().backward()
+    # d out.sum() / d val_k = row-sum of dense = 2 for each
+    np.testing.assert_allclose(vals.grad.numpy(), [2.0, 2.0])
+
+
+def test_sparse_nn_activations_and_softmax():
+    import paddle_tpu.sparse.nn as snn
+    s = sparse.sparse_coo_tensor(np.array([[0, 1], [0, 1]], np.int64),
+                                 np.array([-1.0, 2.0], np.float32), [2, 2])
+    out = snn.ReLU()(s)
+    np.testing.assert_allclose(out.values().numpy(), [0.0, 2.0])
+    out6 = snn.functional.relu6(sparse.sparse_coo_tensor(
+        np.array([[0], [0]], np.int64), np.array([9.0], np.float32), [1, 1]))
+    np.testing.assert_allclose(out6.values().numpy(), [6.0])
+    # csr softmax: single fully-dense row == dense softmax
+    crows = np.array([0, 3], np.int64)
+    cols = np.array([0, 1, 2], np.int64)
+    vals = np.array([1.0, 2.0, 3.0], np.float32)
+    sm = snn.Softmax()(sparse.sparse_csr_tensor(crows, cols, vals, [1, 3]))
+    e = np.exp(vals - vals.max())
+    np.testing.assert_allclose(sm.values().numpy(), e / e.sum(), rtol=1e-5)
+
+
+def test_subm_conv_preserves_pattern():
+    import paddle_tpu.sparse.nn as snn
+    rng = np.random.RandomState(0)
+    dense = np.zeros((1, 5, 5, 2), np.float32)   # NHWC
+    dense[0, 1, 1] = rng.randn(2)
+    dense[0, 3, 2] = rng.randn(2)
+    x = paddle.to_tensor(dense).to_sparse_coo(3)
+    conv = snn.SubmConv2D(2, 4, kernel_size=3, padding=1)
+    out = conv(x)
+    assert out.is_sparse_coo()
+    # pattern preserved: same active sites
+    np.testing.assert_array_equal(np.asarray(out.indices().numpy()),
+                                  np.asarray(x.indices().numpy()))
+    assert out.shape[-1] == 4
+
+
+def test_sparse_conv3d_runs():
+    import paddle_tpu.sparse.nn as snn
+    dense = np.zeros((1, 4, 4, 4, 2), np.float32)
+    dense[0, 1, 1, 1] = [1.0, -1.0]
+    x = paddle.to_tensor(dense).to_sparse_coo(4)
+    conv = snn.Conv3D(2, 3, kernel_size=3, padding=1)
+    out = conv(x)
+    d = out.to_dense().numpy()
+    assert d.shape == (1, 4, 4, 4, 3)
+    assert np.isfinite(d).all()
+
+
+def test_is_same_shape_and_cast():
+    a, b = _coo(), _coo()
+    assert sparse.is_same_shape(a, b)
+    c = sparse.cast(a, value_dtype="float64")
+    assert "float64" in str(c.dtype) or "f64" in str(c.dtype) or \
+        c.values().numpy().dtype == np.float32  # x64 disabled: stays f32

@@ -1,0 +1,158 @@
+"""paddle.static executable surface (ref static Program/Executor over
+ProgramDesc; book test pattern test/book/test_fit_a_line.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def _build_linreg():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [8, 2])
+        y = static.data("y", [8, 1])
+        w = static.create_parameter([2, 1], "float32", name="w")
+        b = static.create_parameter([1], "float32", name="b", is_bias=True)
+        pred = paddle.matmul(x, w) + b
+        loss = paddle.mean((pred - y) ** 2)
+    return main, startup, x, y, w, b, pred, loss
+
+
+def test_fit_a_line_trains():
+    """The book test: linear regression to near-zero loss via Executor.run."""
+    main, startup, x, y, w, b, pred, loss = _build_linreg()
+    with static.program_guard(main, startup):
+        opt = paddle.optimizer.SGD(learning_rate=0.2, parameters=[w, b])
+        opt.minimize(loss)
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(8, 2).astype(np.float32)
+    Y = (X @ np.array([[1.5], [-2.0]]) + 0.3).astype(np.float32)
+
+    exe = static.Executor()
+    exe.run(startup)
+    losses = []
+    for _ in range(60):
+        (lv,) = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < 1e-2, losses[::20]
+    np.testing.assert_allclose(np.asarray(w._data).ravel(), [1.5, -2.0],
+                               atol=0.05)
+
+
+def test_executor_feed_substitution_no_train():
+    main, startup, x, y, w, b, pred, loss = _build_linreg()
+    exe = static.Executor()
+    X1 = np.ones((8, 2), np.float32)
+    X2 = np.full((8, 2), 2.0, np.float32)
+    Y = np.zeros((8, 1), np.float32)
+    (p1,) = exe.run(main, feed={"x": X1, "y": Y}, fetch_list=[pred])
+    (p2,) = exe.run(main, feed={"x": X2, "y": Y}, fetch_list=[pred])
+    np.testing.assert_allclose(p2, 2 * p1, rtol=1e-5)
+
+
+def test_clone_for_test_drops_train_ops():
+    main, startup, x, y, w, b, pred, loss = _build_linreg()
+    with static.program_guard(main, startup):
+        opt = paddle.optimizer.SGD(learning_rate=0.2, parameters=[w, b])
+        opt.minimize(loss)
+    test_prog = main.clone(for_test=True)
+    assert all(op[0] == "op" for op in test_prog.ops)
+    w0 = np.asarray(w._data).copy()
+    exe = static.Executor()
+    exe.run(test_prog, feed={"x": np.ones((8, 2), np.float32),
+                             "y": np.ones((8, 1), np.float32)},
+            fetch_list=[loss])
+    np.testing.assert_allclose(np.asarray(w._data), w0)  # no update happened
+
+
+def test_gradients_and_append_backward():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [3])
+        z = (x * x).sum()
+        g = static.gradients([z], [x])
+    assert g[0] is not None
+
+
+def test_save_load_inference_model(tmp_path):
+    main, startup, x, y, w, b, pred, loss = _build_linreg()
+    import jax.numpy as jnp
+    w._data = jnp.asarray(np.array([[2.0], [3.0]], np.float32))
+    path = str(tmp_path / "linreg")
+    exe = static.Executor()
+    static.save_inference_model(path, [x], [pred], exe, program=main)
+    w._data = jnp.zeros_like(w._data)  # clobber, then reload
+    prog, feed_names, fetches = static.load_inference_model(path, exe)
+    np.testing.assert_allclose(np.asarray(w._data).ravel(), [2.0, 3.0])
+    X = np.ones((8, 2), np.float32)
+    (out,) = exe.run(prog, feed={"x": X, "y": np.zeros((8, 1), np.float32)},
+                     fetch_list=fetches)
+    np.testing.assert_allclose(out, X @ [[2.0], [3.0]] + np.asarray(b._data),
+                               rtol=1e-5)
+
+
+def test_program_state_roundtrip(tmp_path):
+    main, startup, x, y, w, b, pred, loss = _build_linreg()
+    import jax.numpy as jnp
+    w._data = jnp.asarray(np.array([[7.0], [8.0]], np.float32))
+    path = str(tmp_path / "m")
+    static.save(main, path)
+    w._data = jnp.zeros_like(w._data)
+    static.load(main, path)
+    np.testing.assert_allclose(np.asarray(w._data).ravel(), [7.0, 8.0])
+
+
+def test_ema_and_scope():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        w = static.create_parameter([2], "float32", name="wv")
+        ema = static.ExponentialMovingAverage(decay=0.5)
+        import jax.numpy as jnp
+        w._data = jnp.asarray([1.0, 1.0])
+        ema.update()
+        w._data = jnp.asarray([3.0, 3.0])
+        ema.update()
+        with ema.apply():
+            np.testing.assert_allclose(np.asarray(w._data), [2.0, 2.0])
+        np.testing.assert_allclose(np.asarray(w._data), [3.0, 3.0])
+        v = static.global_scope().find_var("wv")
+        assert v is not None and v.get_tensor().shape == (2,)
+
+
+def test_load_inference_model_cross_process(tmp_path):
+    """Registry cleared => the StableHLO artifact alone must serve."""
+    main, startup, x, y, w, b, pred, loss = _build_linreg()
+    import jax.numpy as jnp
+    w._data = jnp.asarray(np.array([[2.0], [3.0]], np.float32))
+    path = str(tmp_path / "xproc")
+    exe = static.Executor()
+    static.save_inference_model(path, [x], [pred], exe, program=main)
+    static._inference_registry.clear()   # simulate a fresh process
+    prog, feed_names, fetches = static.load_inference_model(path, exe)
+    X = np.ones((8, 2), np.float32)
+    (out,) = exe.run(prog, feed={"x": X}, fetch_list=fetches)
+    np.testing.assert_allclose(out, X @ [[2.0], [3.0]] + np.asarray(b._data),
+                               rtol=1e-5)
+
+
+def test_feed_resize_across_runs():
+    """Placeholder grads must not leak across runs (batch size change)."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [2, 4])
+        w = static.create_parameter([4, 1], "float32", name="w2")
+        loss = paddle.mean(paddle.matmul(x, w))
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+        opt.minimize(loss)
+    exe = static.Executor()
+    exe.run(main, feed={"x": np.ones((2, 4), np.float32)}, fetch_list=[loss])
+    exe.run(main, feed={"x": np.ones((3, 4), np.float32)}, fetch_list=[loss])
