@@ -1,3 +1,4 @@
 from . import api  # noqa
 from .api import dtensor_from_fn, reshard, shard_op, shard_tensor  # noqa
+from .engine import Engine  # noqa
 from .process_mesh import ProcessMesh  # noqa

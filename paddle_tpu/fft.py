@@ -70,7 +70,12 @@ def hfftn(x, s=None, axes=None, norm="backward", name=None):
     nm = _norm(norm)
 
     def f(a):
-        ax = tuple(range(a.ndim)) if axes is None else tuple(axes)
+        if axes is None:
+            # numpy semantics: with s given, default to the last len(s) dims
+            ax = tuple(range(a.ndim)) if s is None \
+                else tuple(range(a.ndim - len(s), a.ndim))
+        else:
+            ax = tuple(axes)
         other = ax[:-1]
         out = jnp.fft.ifftn(a, s=None if s is None else s[:-1], axes=other,
                             norm=nm) if other else a
@@ -83,7 +88,11 @@ def ihfftn(x, s=None, axes=None, norm="backward", name=None):
     nm = _norm(norm)
 
     def f(a):
-        ax = tuple(range(a.ndim)) if axes is None else tuple(axes)
+        if axes is None:
+            ax = tuple(range(a.ndim)) if s is None \
+                else tuple(range(a.ndim - len(s), a.ndim))
+        else:
+            ax = tuple(axes)
         out = jnp.fft.ihfft(a, n=None if s is None else s[-1], axis=ax[-1],
                             norm=nm)
         other = ax[:-1]

@@ -418,3 +418,320 @@ def flash_attention_fused(q, k, v, mask=None, causal=False, scale=None,
         key = _gen.next_key()
     return attention_xla(q, k, v, mask=mask, causal=causal, scale=s,
                          dropout_p=dropout_p, dropout_key=key)
+
+
+# ---------------------------------------------------------------------------
+# Varlen (segment-ids) Pallas kernels — ref flash_attn varlen/unpadded
+# (`nn/functional/flash_attention.py:200`): packed sequences attend only within
+# their own segment.  Separate kernels so the dense hot path stays untouched.
+# ---------------------------------------------------------------------------
+
+def _seg_mask(sq, sk, s, q_start, k_start, block_q, block_k, causal):
+    """Combine segment equality (and causality) into the score mask."""
+    m = sq[:, 0][:, None] == sk[:, 0][None, :]
+    if causal:
+        row = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        col = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        m = m & (row >= col)
+    return jnp.where(m, s, NEG_INF), m
+
+
+def _flash_fwd_seg_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref, lse_ref,
+                          acc_ref, m_ref, l_ref, *, block_q, block_k, n_k,
+                          causal, scale):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    run = True
+    if causal:
+        run = q_start + block_q - 1 >= k_start
+
+    @pl.when(run if causal else (ki >= 0))
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        s, mask = _seg_mask(sq_ref[0], sk_ref[0], s, q_start, k_start,
+                            block_q, block_k, causal)
+        m_prev = m_ref[...]
+        l_prev = l_ref[...]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        p = jnp.where(mask, p, 0.0)  # fully-masked rows: no exp(NEG-NEG) mass
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+        l_ref[...] = l_new
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[...] + jnp.log(l)
+
+
+def _flash_bwd_seg_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+                              sq_ref, sk_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                              *, block_q, block_k, n_q, causal, scale):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    run = True
+    if causal:
+        run = q_start + block_q - 1 >= k_start
+
+    @pl.when(run if causal else (qi >= 0))
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]
+        dl = dl_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        s, mask = _seg_mask(sq_ref[0], sk_ref[0], s, q_start, k_start,
+                            block_q, block_k, causal)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        pt = p.astype(do.dtype).T
+        dv_acc[...] += jnp.dot(pt, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - dl) * scale).astype(q.dtype)
+        dk_acc[...] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_seg_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+                             sq_ref, sk_ref, dq_ref, dq_acc, *, block_q,
+                             block_k, n_k, causal, scale):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    run = True
+    if causal:
+        run = q_start + block_q - 1 >= k_start
+
+    @pl.when(run if causal else (ki >= 0))
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]
+        dl = dl_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        s, mask = _seg_mask(sq_ref[0], sk_ref[0], s, q_start, k_start,
+                            block_q, block_k, causal)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - dl) * scale).astype(k.dtype)
+        dq_acc[...] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _seg3(seg, B, H, S):
+    """[B, S] int32 -> [B*H, S, 1] (per-head broadcast for block indexing)."""
+    s = jnp.broadcast_to(seg.astype(jnp.int32)[:, None, :], (B, H, S))
+    return s.reshape(B * H, S, 1)
+
+
+def _flash_seg_fwd_impl(q, k, v, seg_q, seg_k, causal, scale):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, S, H, D = q.shape
+    Sk = k.shape[1]
+    qt = jnp.transpose(q, (0, 2, 1, 3)).reshape(B * H, S, D)
+    kt = jnp.transpose(k, (0, 2, 1, 3)).reshape(B * H, Sk, D)
+    vt = jnp.transpose(v, (0, 2, 1, 3)).reshape(B * H, Sk, D)
+    sq = _seg3(seg_q, B, H, S)
+    sk = _seg3(seg_k, B, H, Sk)
+
+    block_q = _pick_block(S, FWD_BLOCK)
+    block_k = _pick_block(Sk, FWD_BLOCK)
+    n_k = Sk // block_k
+    kernel = functools.partial(_flash_fwd_seg_kernel, block_q=block_q,
+                               block_k=block_k, n_k=n_k, causal=causal,
+                               scale=scale)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(B * H, S // block_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, 1), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, S, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(qt, kt, vt, sq, sk)
+    return jnp.transpose(out.reshape(B, H, S, D), (0, 2, 1, 3)), lse
+
+
+def _flash_seg_bwd_impl(q, k, v, seg_q, seg_k, out, lse, g, causal, scale):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, S, H, D = q.shape
+    Sk = k.shape[1]
+    qt = jnp.transpose(q, (0, 2, 1, 3)).reshape(B * H, S, D)
+    kt = jnp.transpose(k, (0, 2, 1, 3)).reshape(B * H, Sk, D)
+    vt = jnp.transpose(v, (0, 2, 1, 3)).reshape(B * H, Sk, D)
+    dot = jnp.transpose(g, (0, 2, 1, 3)).reshape(B * H, S, D)
+    sq = _seg3(seg_q, B, H, S)
+    sk = _seg3(seg_k, B, H, Sk)
+    delta = jnp.sum(dot.astype(jnp.float32) *
+                    jnp.transpose(out, (0, 2, 1, 3)).reshape(B * H, S, D)
+                    .astype(jnp.float32), axis=-1, keepdims=True)
+
+    block_q = _pick_block(S, BWD_BLOCK)
+    block_k = _pick_block(Sk, BWD_BLOCK)
+    n_q = S // block_q
+    n_k = Sk // block_k
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_seg_dkv_kernel, block_q=block_q,
+                          block_k=block_k, n_q=n_q, causal=causal, scale=scale),
+        grid=(B * H, n_k, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, 1), lambda b, j, i: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Sk, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, Sk, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(qt, kt, vt, dot, lse, delta, sq, sk)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_seg_dq_kernel, block_q=block_q,
+                          block_k=block_k, n_k=n_k, causal=causal, scale=scale),
+        grid=(B * H, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, 1), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(qt, kt, vt, dot, lse, delta, sq, sk)
+
+    tr = lambda x, L: jnp.transpose(x.reshape(B, H, L, D), (0, 2, 1, 3))
+    return tr(dq, S), tr(dk, Sk), tr(dv, Sk)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _flash_attention_seg_core(q, k, v, seg_q, seg_k, causal, scale):
+    out, _ = _flash_seg_fwd_impl(q, k, v, seg_q, seg_k, causal, scale)
+    return out
+
+
+def _flash_seg_fwd(q, k, v, seg_q, seg_k, causal, scale):
+    out, lse = _flash_seg_fwd_impl(q, k, v, seg_q, seg_k, causal, scale)
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
+    return out, (q, k, v, seg_q, seg_k, out, lse)
+
+
+def _flash_seg_bwd(causal, scale, res, g):
+    q, k, v, seg_q, seg_k, out, lse = res
+    dq, dk, dv = _flash_seg_bwd_impl(q, k, v, seg_q, seg_k, out, lse, g,
+                                     causal, scale)
+    return dq, dk, dv, None, None  # integer segment ids carry no tangent
+
+
+_flash_attention_seg_core.defvjp(_flash_seg_fwd, _flash_seg_bwd)
+
+
+def attention_xla_segmented(q, k, v, seg_q, seg_k, causal, scale):
+    """XLA oracle for the varlen kernel (tests + CPU fallback)."""
+    mask = seg_q[:, None, :, None] == seg_k[:, None, None, :]   # [B,1,S,Sk]
+    return attention_xla(q, k, v, mask=mask, causal=causal, scale=scale)
+
+
+def flash_attention_varlen(q, k, v, segment_ids, kv_segment_ids=None,
+                           causal=True, scale=None):
+    """Segment-masked flash attention (varlen packing): q, k, v [B, S, H, D],
+    segment_ids [B, S] int — tokens attend only within their own segment."""
+    D = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(D)
+    seg_k = segment_ids if kv_segment_ids is None else kv_segment_ids
+    if _on_tpu() and _shapes_ok_for_pallas(q, k):
+        return _flash_attention_seg_core(q, k, v, segment_ids, seg_k,
+                                         causal, s)
+    return attention_xla_segmented(q, k, v, segment_ids, seg_k, causal, s)

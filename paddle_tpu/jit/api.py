@@ -33,8 +33,6 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
               **kwargs):
     """Decorator/wrapper converting a dygraph function or Layer to a compiled program."""
     def decorate(fn):
-        if not _to_static_enabled[0]:
-            return fn  # capture disabled: dygraph passthrough
         if isinstance(fn, Layer):
             static = StaticFunction(fn, input_spec)
             fn.forward = static

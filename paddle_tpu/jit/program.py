@@ -250,6 +250,11 @@ class StaticFunction:
         return None
 
     def __call__(self, *args, **kwargs):
+        from .api import _to_static_enabled
+        if not _to_static_enabled[0]:
+            # enable_to_static(False): run the original dygraph function (the
+            # check is per-call so the switch works after decoration too)
+            return self._fn(*args, **kwargs)
         layer = self._layer if isinstance(self._layer, Layer) else None
         training = layer.training if layer is not None else False
         with_grad = _ag.is_grad_enabled() and (

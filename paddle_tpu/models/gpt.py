@@ -143,21 +143,27 @@ def _norm(x, w, b, config):
     return (out * w + b).astype(x.dtype)
 
 
-def _rope_tables(config, S):
+def _rope_tables(config, S, pos_offset=None):
     D = config.head_dim
     inv = 1.0 / (10000.0 ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
     t = jnp.arange(S, dtype=jnp.float32)
+    if pos_offset is not None:
+        t = t + pos_offset.astype(jnp.float32)  # context-parallel seq shard
     freqs = jnp.outer(t, inv)
     return jnp.sin(freqs), jnp.cos(freqs)
 
 
-def block_forward(bp, x, config: GPTConfig, mp_constraint=None, moe_impl=None):
+def block_forward(bp, x, config: GPTConfig, mp_constraint=None, moe_impl=None,
+                  attn_impl=None, pos_offset=None):
     """One transformer block; bp holds this block's (unstacked) weights.
 
     mp_constraint: optional callable applying sharding constraints on activations
     (set by the hybrid trainer to pin the tensor-parallel layout).
     moe_impl: optional callable (bp, x2d, config) -> (y2d, aux) overriding the
     MoE FFN (the hybrid trainer injects the ep-axis all-to-all version).
+    attn_impl: optional callable (q, k, v) -> out overriding causal flash
+    attention (the cp trainer injects ring attention).
+    pos_offset: traced global position of x[:, 0] (context-parallel shards).
 
     Returns (out, aux) where aux is the MoE load-balance loss (0.0 when dense).
     """
@@ -174,7 +180,7 @@ def block_forward(bp, x, config: GPTConfig, mp_constraint=None, moe_impl=None):
     kk = kk.reshape(B, S, H, hd)
     v = v.reshape(B, S, H, hd)
     if c.use_rope:
-        sin, cos = _rope_tables(c, S)
+        sin, cos = _rope_tables(c, S, pos_offset)
         q = apply_rope(q, sin, cos)
         kk = apply_rope(kk, sin, cos)
     # saved under remat_policy_save_attention: the block replay then DCEs the qkv
@@ -183,7 +189,10 @@ def block_forward(bp, x, config: GPTConfig, mp_constraint=None, moe_impl=None):
     q = checkpoint_name(q, "flash_qkv")
     kk = checkpoint_name(kk, "flash_qkv")
     v = checkpoint_name(v, "flash_qkv")
-    attn = flash_attention_fused(q, kk, v, causal=True)
+    if attn_impl is not None:
+        attn = attn_impl(q, kk, v)
+    else:
+        attn = flash_attention_fused(q, kk, v, causal=True)
     attn = attn.reshape(B, S, D)
     attn = jnp.matmul(attn, bp["proj_w"]) + bp["proj_b"]
     x = x + attn
@@ -202,7 +211,8 @@ def block_forward(bp, x, config: GPTConfig, mp_constraint=None, moe_impl=None):
     return x + h, jnp.zeros((), jnp.float32)
 
 
-def run_blocks(blocks, x, config, mp_constraint=None, remat=False, moe_impl=None):
+def run_blocks(blocks, x, config, mp_constraint=None, remat=False, moe_impl=None,
+               attn_impl=None, pos_offset=None):
     """Scan the stacked blocks: one compiled block body, L iterations.
 
     Returns (out, aux) — aux is the summed MoE load-balance loss over blocks."""
@@ -214,12 +224,13 @@ def run_blocks(blocks, x, config, mp_constraint=None, remat=False, moe_impl=None
         # remat.  The policy saves the flash-attention out/lse residuals, so the
         # block replay re-runs only the (cheap) matmul chain — attention forward
         # runs exactly once per step instead of ~3x (round-1 remat tax).
-        body = jax.checkpoint(block_forward, static_argnums=(2, 3, 4),
+        body = jax.checkpoint(block_forward, static_argnums=(2, 3, 4, 5),
                               policy=remat_policy_save_attention())
 
     def step(carry, bp):
         x, aux = carry
-        out, a = body(bp, x, config, mp_constraint, moe_impl)
+        out, a = body(bp, x, config, mp_constraint, moe_impl, attn_impl,
+                      pos_offset)
         return (out, aux + a), None
 
     # inside a shard_map (pp loop) x is varying over the manual axes; the aux

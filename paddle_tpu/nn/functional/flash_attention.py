@@ -12,7 +12,33 @@ from ...core.tensor import Tensor, apply
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False,
-                    fixed_seed_offset=None, rng_name="", training=True, name=None):
+                    fixed_seed_offset=None, rng_name="", training=True,
+                    segment_ids=None, name=None):
+    """segment_ids [B, S] (TPU-native varlen form): when given, tokens attend
+    only within their own segment via the Pallas varlen kernel."""
+    if segment_ids is not None:
+        seg = segment_ids._data if isinstance(segment_ids, Tensor) \
+            else jnp.asarray(segment_ids)
+        if dropout == 0.0:
+            from ...incubate.kernels.flash_attention import \
+                flash_attention_varlen
+            out = apply("flash_attention_varlen",
+                        lambda q, k, v: flash_attention_varlen(q, k, v, seg,
+                                                               causal=causal),
+                        query, key, value)
+        else:
+            # dropout path: segment mask through the composed XLA attention
+            from ...incubate.kernels.flash_attention import attention_xla
+            from ...core import generator as _gen
+            key_ = _gen.next_key() if training else None
+            mask = (seg[:, None, :, None] == seg[:, None, None, :])
+            out = apply("flash_attention_seg_dropout",
+                        lambda q, k, v: attention_xla(
+                            q, k, v, mask=mask, causal=causal,
+                            dropout_p=dropout if training else 0.0,
+                            dropout_key=key_),
+                        query, key, value)
+        return out, None
     from ...incubate.nn.functional import fused_dot_product_attention
     out = fused_dot_product_attention(query, key, value, attn_mask=None,
                                       dropout_p=dropout, is_causal=causal,
@@ -28,7 +54,32 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k, max_seqle
                         training=True, name=None):
     """Varlen flash attention: total-token packed layout [total, H, D] with cumulative
     sequence offsets (reference `flash_attn_unpadded`).  Implemented by segment-masked
-    attention over the packed dimension — static shapes, so it stays jittable."""
+    attention over the packed dimension — static shapes, so it stays jittable.
+    On TPU with aligned shapes the Pallas varlen kernel runs; otherwise the XLA
+    composed path."""
+    from ...incubate.kernels.flash_attention import (_on_tpu,
+                                                     flash_attention_varlen)
+
+    def kernel_path(q, k, v, cu_q, cu_k):
+        total_q, H, D = q.shape
+        total_k = k.shape[0]
+        nseq = cu_q.shape[0] - 1
+        pad_q = (-total_q) % 128
+        pad_k = (-total_k) % 128
+        seg_q = jnp.searchsorted(cu_q[1:], jnp.arange(total_q), side="right")
+        seg_k = jnp.searchsorted(cu_k[1:], jnp.arange(total_k), side="right")
+        # pad tokens get segment ids that never match -> attend nothing
+        seg_qp = jnp.concatenate([seg_q, jnp.full((pad_q,), nseq + 1,
+                                                  seg_q.dtype)])[None]
+        seg_kp = jnp.concatenate([seg_k, jnp.full((pad_k,), nseq + 2,
+                                                  seg_k.dtype)])[None]
+        qp = jnp.pad(q, ((0, pad_q), (0, 0), (0, 0)))[None]
+        kp = jnp.pad(k, ((0, pad_k), (0, 0), (0, 0)))[None]
+        vp = jnp.pad(v, ((0, pad_k), (0, 0), (0, 0)))[None]
+        out = flash_attention_varlen(qp, kp, vp, seg_qp, seg_kp,
+                                     causal=causal, scale=scale)
+        return out[0, :total_q]
+
     def f(q, k, v, cu_q, cu_k):
         total_q = q.shape[0]
         total_k = k.shape[0]
@@ -47,5 +98,9 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k, max_seqle
         p = p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
         out = jnp.einsum("hqk,khd->qhd", p, v.astype(jnp.float32))
         return out.astype(q.dtype)
-    out = apply("flash_attn_unpadded", f, query, key, value, cu_seqlens_q, cu_seqlens_k)
+
+    D = (query._data if isinstance(query, Tensor) else query).shape[-1]
+    use_kernel = _on_tpu() and D in (64, 128, 256) and dropout == 0.0
+    out = apply("flash_attn_unpadded", kernel_path if use_kernel else f,
+                query, key, value, cu_seqlens_q, cu_seqlens_k)
     return out, None

@@ -40,6 +40,7 @@ class MeshConfig:
     sharding: int = 1            # ZeRO axis degree (ref topology.py:61 axis order)
     mp: int = 1
     ep: int = 1                  # expert-parallel degree (MoE all-to-all group)
+    cp: int = 1                  # context-parallel degree (ring attention)
     sharding_stage: int = 1      # ZeRO stage: 1=opt state, 2=+grads, 3=+params
     micro_batches: int = 1       # pipeline microbatches (per global step)
     sequence_parallel: bool = False
@@ -47,7 +48,7 @@ class MeshConfig:
 
     @property
     def size(self):
-        return self.dp * self.pp * self.sharding * self.mp * self.ep
+        return self.dp * self.pp * self.sharding * self.mp * self.ep * self.cp
 
     @property
     def zero_axis(self):
@@ -62,11 +63,11 @@ def build_mesh(cfg: MeshConfig, devices=None) -> Mesh:
     devs = np.array(devices if devices is not None else jax.devices()[:cfg.size])
     assert devs.size >= cfg.size, f"need {cfg.size} devices, have {devs.size}"
     # axis order mirrors the reference hybrid topology ["data","pipe","sharding",
-    # "model"] (fleet/base/topology.py:61) with the MoE 'ep' axis innermost so
-    # the dispatch all-to-all rides adjacent ICI links
+    # "model"] (fleet/base/topology.py:61) with the MoE 'ep' and ring 'cp' axes
+    # innermost so their all-to-all/ppermute ride adjacent ICI links
     return Mesh(devs[:cfg.size].reshape(cfg.dp, cfg.pp, cfg.sharding, cfg.mp,
-                                        cfg.ep),
-                ("dp", "pp", "sharding", "mp", "ep"))
+                                        cfg.ep, cfg.cp),
+                ("dp", "pp", "sharding", "mp", "ep", "cp"))
 
 
 # ---------------------------------------------------------------------------
@@ -210,6 +211,52 @@ def _moe_ffn_ep(bp, x, config, cfg: MeshConfig, mesh):
         out_specs=(P("ep"), P()))(
             bp["gate_w"], bp["exp_fc1_w"], bp["exp_fc1_b"],
             bp["exp_fc2_w"], bp["exp_fc2_b"], x)
+
+
+# ---------------------------------------------------------------------------
+# context-parallel loss: sequence sharded over 'cp', ring attention inside
+# ---------------------------------------------------------------------------
+
+def _cp_loss(params, tokens, labels, config, cfg: MeshConfig, mesh):
+    """Long-context training: tokens/labels [B, S] with S sharded over 'cp';
+    every block's attention runs the ring (SURVEY §7.10 — beyond-reference)."""
+    import functools
+
+    from .ring_attention import ring_attention_local
+
+    cp = cfg.cp
+    B, S = tokens.shape
+    Sl = S // cp
+    assert S % cp == 0, f"seq len {S} must divide over cp={cp}"
+    attn = functools.partial(ring_attention_local, axis_name="cp", cp=cp,
+                             causal=True)
+
+    # embedding + LM head run OUTSIDE the manual cp region so the existing
+    # vocab-parallel shard_maps handle the mp-sharded table (a vocab-sharded
+    # gather under auto axes CHECK-crashes XLA's partitioner)
+    x = _vp_embed(params["wte"], tokens, mesh, cfg)
+    if not config.use_rope:
+        x = x + params["wpe"][:S]
+
+    def local(blocks, lnf_w, lnf_b, x_l):
+        r = jax.lax.axis_index("cp")
+        offset = r * Sl
+        x_l, aux = gpt_mod.run_blocks(blocks, x_l, config, remat=cfg.remat,
+                                      attn_impl=attn, pos_offset=offset)
+        h = gpt_mod._norm(x_l, lnf_w, lnf_b, config)
+        return h, jax.lax.psum(aux, "cp")
+
+    blk_specs = jax.tree_util.tree_map(lambda _: P(), params["blocks"])
+    h, aux = jax.shard_map(
+        local, mesh=mesh, axis_names={"cp"},
+        in_specs=(blk_specs, P(), P(), P(None, "cp", None)),
+        out_specs=(P(None, "cp", None), P()))(
+            params["blocks"], params["lnf_w"], params["lnf_b"], x)
+    head = params["wte"].T if config.tie_word_embeddings else params["lm_head"]
+    loss = _vp_ce(h, head, labels, mesh, cfg)
+    if config.moe_num_experts > 0:
+        loss = loss + config.moe_aux_weight * aux
+    return loss
 
 
 # ---------------------------------------------------------------------------
@@ -459,9 +506,16 @@ class HybridParallelTrainer:
         if config.moe_num_experts > 0 and cfg.ep > 1:
             moe_impl = functools.partial(_moe_ffn_ep, cfg=cfg, mesh=mesh)
 
+        if cfg.cp > 1:
+            assert cfg.pp == 1 and cfg.ep == 1, \
+                "cp composes with dp/sharding/mp; cp x pp / cp x ep are not " \
+                "supported yet"
+
         def loss_of(params, tokens, labels):
             if cfg.pp > 1:
                 return _pp_loss(params, tokens, labels, config, cfg, mesh)
+            if cfg.cp > 1:
+                return _cp_loss(params, tokens, labels, config, cfg, mesh)
             return gpt_mod.loss_fn(params, tokens, labels, config,
                                    mp_constraint=self._mp_constraint,
                                    remat=cfg.remat, moe_impl=moe_impl)

@@ -329,7 +329,9 @@ def transpose(x, perm, name=None):
 def _dense_to_coo(dense, sparse_dim=None):
     d = np.asarray(dense._data)
     sd = sparse_dim or d.ndim
-    nz = np.nonzero(d.reshape(d.shape[:sd] + (-1,)).sum(-1) != 0
+    # a site is active if ANY trailing-dim value is nonzero (sum would drop
+    # sites whose values cancel, e.g. channels [1, -1])
+    nz = np.nonzero((d.reshape(d.shape[:sd] + (-1,)) != 0).any(-1)
                     if sd < d.ndim else d)
     idx = jnp.asarray(np.stack(nz), jnp.int64)
     vals = apply("gather_nz", lambda a: a[nz], dense)
@@ -344,7 +346,7 @@ def is_same_shape(x, y):
 # binary / multiary (ref sparse/binary.py, multiary.py)
 # ---------------------------------------------------------------------------
 
-def _binary(name, jfn):
+def _binary(name, jfn, same_pattern_only=False):
     def op(x, y, name=None):
         if _is_sparse(x) and _is_sparse(y):
             # same-structure fast path
@@ -353,6 +355,10 @@ def _binary(name, jfn):
                     and bool(jnp.all(x._indices == y._indices)):
                 vals = apply(name_, jfn, x._values, y._values)
                 return SparseCooTensor(x._indices, vals, x._shape)
+            if same_pattern_only:
+                # densifying would evaluate x/0 and 0/0 over the union (NaNs)
+                raise ValueError(
+                    f"sparse {name_} requires identical sparsity patterns")
             dense = apply(name_, jfn, x.to_dense(), y.to_dense())
             return _dense_to_coo(dense)
         xd = x.to_dense() if _is_sparse(x) else x
@@ -366,32 +372,42 @@ def _binary(name, jfn):
 add = _binary("sparse_add", jnp.add)
 subtract = _binary("sparse_subtract", jnp.subtract)
 multiply = _binary("sparse_multiply", jnp.multiply)
-divide = _binary("sparse_divide", jnp.divide)
+divide = _binary("sparse_divide", jnp.divide, same_pattern_only=True)
 
 
 def matmul(x, y, name=None):
     """sparse @ dense -> dense (ref sparse matmul): gather rows by the sparse
-    pattern and accumulate — one fused XLA scatter over an MXU matmul."""
-    if isinstance(x, SparseCsrTensor):
+    pattern and accumulate — one fused XLA scatter over an MXU matmul.
+    Batched (>2-D) operands densify first (dense batched matmul IS the MXU
+    path; the gather formulation only wins for the 2-D case)."""
+    from ..ops.math import matmul as dmatmul
+    if isinstance(x, SparseCsrTensor) and len(x._shape) == 2:
         x = x.to_sparse_coo()
     if isinstance(x, SparseCooTensor):
+        yd = y.to_dense() if _is_sparse(y) else y
+        ynd = yd._data.ndim if isinstance(yd, Tensor) else np.ndim(yd)
+        if len(x._shape) > 2 or ynd != 2:
+            return dmatmul(x.to_dense(), yd)
         rows, cols = x._indices[0], x._indices[1]
         M = x._shape[0]
-        yd = y.to_dense() if _is_sparse(y) else y
 
         def f(v, b):
             contrib = v[:, None] * b[cols]           # [nnz, N]
             return jax.ops.segment_sum(contrib, rows.astype(jnp.int32),
                                        num_segments=M)
         return apply("sparse_matmul", f, x._values, yd)
-    # dense @ sparse: transpose trick
+    if _is_sparse(x):
+        return dmatmul(x.to_dense(), y.to_dense() if _is_sparse(y) else y)
+    # dense @ sparse: transpose trick (2-D); batched densifies
     if _is_sparse(y):
+        xnd = x._data.ndim if isinstance(x, Tensor) else np.ndim(x)
+        if xnd != 2 or len(y.shape) != 2:
+            return dmatmul(x, y.to_dense())
         from ..ops.manipulation import transpose as dtr
-        xt = dtr(x, list(range(x.ndim - 2)) + [x.ndim - 1, x.ndim - 2])
+        xt = dtr(x, [1, 0])
         yt = transpose(y, [1, 0])
         out = matmul(yt, xt)
         return dtr(out, [1, 0])
-    from ..ops.math import matmul as dmatmul
     return dmatmul(x, y)
 
 

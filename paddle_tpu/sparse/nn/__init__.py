@@ -82,7 +82,8 @@ class Softmax(Layer):
             vals = apply("sparse_softmax", f, x.values())
             return SparseCsrTensor(x._crows, x._cols, vals, x._shape)
         if isinstance(x, SparseCooTensor):
-            return Softmax()(x.to_sparse_csr())
+            # return in the input format (ref: format-preserving)
+            return Softmax()(x.to_sparse_csr()).to_sparse_coo()
         from ...nn.functional.activation import softmax as dsoftmax
         return dsoftmax(x, axis=-1)
 

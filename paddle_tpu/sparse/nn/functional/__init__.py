@@ -60,16 +60,14 @@ def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
 
 
 def _dense_conv_sparse_w(x, weight, bias, stride, padding, dims, subm):
-    """Functional form: reference weights are [*ks, in, out]; the layer path
-    stores [out, in, *ks] — detect and adapt."""
+    """Functional form takes REFERENCE layout weights [*ks, Cin, Cout]
+    (sparse/nn/functional/conv.py) and transposes to the layer layout
+    [Cout, Cin, *ks] — no shape heuristics."""
     from .. import _dense_conv_sparse
     from ....ops.manipulation import transpose as tr
-    w = weight
-    wd = w._data if isinstance(w, Tensor) else jnp.asarray(w)
-    if wd.ndim == dims + 2 and wd.shape[-1] != wd.shape[0]:
-        # heuristic: reference layout [*ks, Cin, Cout] -> [Cout, Cin, *ks]
-        perm = [dims + 1, dims] + list(range(dims))
-        w = tr(w, perm) if isinstance(w, Tensor) else Tensor(jnp.transpose(wd, perm))
+    perm = [dims + 1, dims] + list(range(dims))
+    w = tr(weight, perm) if isinstance(weight, Tensor) \
+        else Tensor(jnp.transpose(jnp.asarray(weight), perm))
     return _dense_conv_sparse(x, w, bias, stride, padding, dims, subm)
 
 

@@ -1,0 +1,91 @@
+"""Auto-parallel Engine + distributed checkpoint resharding
+(ref auto_parallel/static/engine.py:55, dist_saver.py, converter.py).
+
+The VERDICT acceptance test: train on mesh (dp2, mp2), save, resume on a
+DIFFERENT mesh (dp4 / mp1) — losses continue on-curve vs an uninterrupted run.
+"""
+import jax
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.auto_parallel import Engine
+from paddle_tpu.models.gpt import gpt_tiny
+from paddle_tpu.parallel import HybridParallelTrainer, MeshConfig
+
+
+def _data(cfg, n=32, S=64, seed=0):
+    rng = np.random.RandomState(seed)
+    tok = rng.randint(0, cfg.vocab_size, (n, S)).astype(np.int32)
+    return tok, np.roll(tok, -1, axis=1).astype(np.int32)
+
+
+def test_engine_fit_evaluate_predict():
+    cfg = gpt_tiny(64)
+    tok, lab = _data(cfg)
+    eng = Engine(config=cfg, mesh_config=MeshConfig(dp=2, mp=2),
+                 devices=jax.devices()[:4], seed=3)
+    hist = eng.fit((tok, lab), epochs=2, batch_size=8, verbose=0)
+    assert len(hist["loss"]) == 8
+    assert hist["loss"][-1] < hist["loss"][0]
+    ev = eng.evaluate((tok[:8], lab[:8]), verbose=0)
+    assert np.isfinite(ev)
+    logits = eng.predict(tok[:4], batch_size=4)
+    assert logits.shape == (4, 64, cfg.vocab_size)
+
+
+def test_checkpoint_reshard_resume_on_curve(tmp_path):
+    """Save on (dp2, mp2), resume on (dp4) and on (mp2): both continue exactly
+    on the uninterrupted loss curve."""
+    cfg = gpt_tiny(64)
+    tok, lab = _data(cfg, n=8)
+
+    # uninterrupted reference: 6 steps on (dp2, mp2)
+    ref = Engine(config=cfg, mesh_config=MeshConfig(dp=2, mp=2),
+                 devices=jax.devices()[:4], seed=3)
+    ref_losses = [float(ref.trainer.train_step(tok, lab)) for _ in range(6)]
+
+    # interrupted: 3 steps, save, resume on two different meshes
+    a = Engine(config=cfg, mesh_config=MeshConfig(dp=2, mp=2),
+               devices=jax.devices()[:4], seed=3)
+    first = [float(a.trainer.train_step(tok, lab)) for _ in range(3)]
+    np.testing.assert_allclose(first, ref_losses[:3], rtol=1e-5)
+    path = str(tmp_path / "ckpt")
+    a.save(path)
+
+    for mesh_cfg, ndev in ((MeshConfig(dp=4), 4), (MeshConfig(mp=2), 2)):
+        b = Engine(config=cfg, mesh_config=mesh_cfg,
+                   devices=jax.devices()[:ndev], seed=999)  # different init
+        b.load(path)
+        rest = [float(b.trainer.train_step(tok, lab)) for _ in range(3)]
+        np.testing.assert_allclose(rest, ref_losses[3:], rtol=2e-4)
+
+
+def test_checkpoint_metadata_written(tmp_path):
+    cfg = gpt_tiny(64)
+    eng = Engine(config=cfg, mesh_config=MeshConfig(mp=2, sharding=2,
+                                                    sharding_stage=2),
+                 devices=jax.devices()[:4], seed=0)
+    path = str(tmp_path / "meta")
+    eng.save(path)
+    from paddle_tpu.distributed.checkpoint import saved_dist_attr
+    meta = saved_dist_attr(path)
+    assert meta["mesh"]["axes"] == ["dp", "pp", "sharding", "mp", "ep", "cp"]
+    # qkv weight is mp-sharded on its last dim
+    qkv = meta["leaves"]["params/blocks/qkv_w"]
+    assert qkv[-1] == "mp"
+
+
+def test_checkpoint_without_optimizer(tmp_path):
+    cfg = gpt_tiny(64)
+    tok, lab = _data(cfg, n=8)
+    a = Engine(config=cfg, mesh_config=MeshConfig(), devices=jax.devices()[:1],
+               seed=3)
+    a.trainer.train_step(tok, lab)
+    path = str(tmp_path / "infer_only")
+    a.save(path, training=False)
+    b = Engine(config=cfg, mesh_config=MeshConfig(), devices=jax.devices()[:1],
+               seed=7)
+    b.load(path, load_optimizer=False)
+    la = float(a.trainer.eval_loss(tok, lab))
+    lb = float(b.trainer.eval_loss(tok, lab))
+    np.testing.assert_allclose(la, lb, rtol=1e-5)
