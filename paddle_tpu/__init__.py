@@ -102,7 +102,10 @@ from . import linalg  # noqa
 from . import metric  # noqa
 from . import nn  # noqa
 from . import optimizer  # noqa
+from . import inference  # noqa
+from . import onnx  # noqa
 from . import profiler  # noqa
+from . import quantization  # noqa
 from . import sparse  # noqa
 from . import static  # noqa
 from . import utils  # noqa

@@ -27,6 +27,9 @@ class Engine:
                  cluster=None, strategy=None, config=None, mesh_config=None,
                  devices=None, **trainer_kwargs):
         from ...parallel import HybridParallelTrainer, MeshConfig
+        if strategy is not None and mesh_config is None and \
+                hasattr(strategy, "to_mesh_config"):
+            mesh_config = strategy.to_mesh_config()  # DistributedStrategy knobs
         if model is not None and hasattr(model, "train_step"):
             self.trainer = model
         else:
@@ -42,7 +45,7 @@ class Engine:
     @staticmethod
     def _batches(data, batch_size):
         if isinstance(data, (tuple, list)) and len(data) == 2 \
-                and not hasattr(data[0], "__getitem__") is False:
+                and hasattr(data[0], "shape"):  # (tokens, labels) array pair
             tokens, labels = np.asarray(data[0]), np.asarray(data[1])
             n = tokens.shape[0]
             bs = batch_size or n
@@ -96,10 +99,11 @@ class Engine:
         data = test_data if isinstance(test_data, (tuple, list)) \
             else (test_data,)
         tokens = np.asarray(data[0])
-        bs = batch_size or tokens.shape[0]
-        for i in range(0, tokens.shape[0] - bs + 1, bs):
+        n = tokens.shape[0]
+        bs = batch_size or n
+        for i in range(0, n, bs):   # includes the tail remainder batch
             logits = self._predict_fn(tr.params,
-                                      jnp.asarray(tokens[i:i + bs]))
+                                      jnp.asarray(tokens[i:min(i + bs, n)]))
             outs.append(np.asarray(logits))
         return np.concatenate(outs, axis=0) if outs else None
 
@@ -116,16 +120,19 @@ class Engine:
         checkpoint was saved on (ref converter.py cross-mesh resume)."""
         from .. import checkpoint as ckpt
         tr = self.trainer
-        targets = {"params": tr.param_shardings}
-        opt_sh = {"m": tr._m_shardings, "v": tr._m_shardings, "step": None}
-        if load_optimizer:
-            targets["opt"] = opt_sh
-        state = ckpt.load_state_dict(path, targets)
-        tr.params = state["params"]
+        # load to host first: the checkpoint may or may not contain optimizer
+        # state, so resharding is applied per present section
+        state = ckpt.load_state_dict(path)
+        tr.params = jax.tree_util.tree_map(
+            lambda a, sh: jax.device_put(a, sh), state["params"],
+            tr.param_shardings)
         if load_optimizer and "opt" in state:
-            step = state["opt"]["step"]
-            state["opt"]["step"] = jnp.asarray(step)
-            tr.opt_state = state["opt"]
+            opt = state["opt"]
+            m = jax.tree_util.tree_map(lambda a, sh: jax.device_put(a, sh),
+                                       opt["m"], tr._m_shardings)
+            v = jax.tree_util.tree_map(lambda a, sh: jax.device_put(a, sh),
+                                       opt["v"], tr._m_shardings)
+            tr.opt_state = {"m": m, "v": v, "step": jnp.asarray(opt["step"])}
         return self
 
     @property

@@ -54,6 +54,40 @@ class DistributedStrategy:
         self.auto_search = False
         self.without_graph_optimization = True
 
+    def to_mesh_config(self):
+        """Lower the strategy to the compiled trainer's MeshConfig — the
+        TPU-native equivalent of the reference's meta-optimizer pass stack
+        consuming this object (each knob selects a program transformation;
+        here they select mesh axes / remat / ZeRO stage)."""
+        from ...parallel import MeshConfig
+        h = self.hybrid_configs
+        sharding_degree = 1
+        sharding_stage = 1
+        if self.sharding:
+            sharding_degree = int(self.sharding_configs.get("sharding_degree", 1))
+            sharding_stage = int(self.sharding_configs.get("stage", 1))
+        elif h.get("sharding_degree", 1) > 1:
+            sharding_degree = int(h["sharding_degree"])
+        pp = int(h.get("pp_degree", 1))
+        micro = int(self.pipeline_configs.get("accumulate_steps", 1)) \
+            if (self.pipeline or pp > 1) else 1
+        mp = int(h.get("mp_degree", 1))
+        if self.tensor_parallel:
+            mp = max(mp, int(self.tensor_parallel_configs.get(
+                "tensor_parallel_degree", 1)))
+        return MeshConfig(
+            dp=int(h.get("dp_degree", 1)),
+            pp=pp,
+            sharding=sharding_degree,
+            mp=mp,
+            ep=int(h.get("ep_degree", 1)),
+            cp=int(h.get("sep_degree", 1)),   # sequence axis -> ring CP
+            sharding_stage=sharding_stage,
+            micro_batches=max(micro, 1),
+            sequence_parallel=bool(h.get("mp_configs", {})
+                                   .get("sequence_parallel", False)),
+            remat=bool(self.recompute))
+
     def __repr__(self):
         keys = ["hybrid_configs", "amp", "recompute", "sharding", "pipeline"]
         return "DistributedStrategy(" + ", ".join(

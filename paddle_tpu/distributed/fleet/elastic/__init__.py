@@ -1,0 +1,127 @@
+"""Elastic training: membership, heartbeat, scale up/down decisions.
+
+Reference parity: `fleet/elastic/manager.py:126` (ElasticManager — etcd-backed
+member registry, heartbeat watchdog, np scaling, pod relaunch).
+
+TPU-native: no etcd dependency — process liveness is the heartbeat (the launch
+CLI polls its containers) and membership lives in the manager; the decision
+logic (restart vs scale-down vs exit, min/max np window, ELASTIC_TIMEOUT
+grace) matches the reference.
+"""
+from __future__ import annotations
+
+import time
+from enum import IntEnum
+from typing import Dict, Optional
+
+
+class ElasticStatus(IntEnum):
+    COMPLETED = 0
+    NORMAL = 1       # all members healthy
+    RESTART = 2      # restart the pod at the same size
+    HOLD = 3         # members missing but inside the grace window
+    EXIT = 4         # below min np / restarts exhausted — give up
+
+
+def parse_np(np_spec) -> tuple:
+    """'2:4' -> (2, 4); '4' / 4 -> (4, 4) (ref manager np parsing)."""
+    if np_spec is None:
+        return (1, 1)
+    if isinstance(np_spec, int):
+        return (np_spec, np_spec)
+    s = str(np_spec)
+    if ":" in s:
+        lo, hi = s.split(":")
+        lo, hi = int(lo), int(hi)
+    else:
+        lo = hi = int(s)
+    if lo < 1 or hi < lo:
+        raise ValueError(f"bad np range {np_spec!r}")
+    return lo, hi
+
+
+class ElasticManager:
+    """Tracks member heartbeats and decides pod actions (ref ElasticManager)."""
+
+    def __init__(self, np_spec="1", timeout: float = 10.0, max_restart: int = 3,
+                 clock=time.monotonic):
+        self.min_np, self.max_np = parse_np(np_spec)
+        self.timeout = timeout
+        self.max_restart = max_restart
+        self.restarts = 0
+        self._clock = clock
+        self._members: Dict[int, float] = {}
+        self._grace_start: Optional[float] = None
+        self._reported = False
+
+    # ---- membership ----
+    def register(self, rank: int):
+        self._members[rank] = self._clock()
+        self._grace_start = None
+
+    def heartbeat(self, rank: int):
+        if rank in self._members:
+            self._members[rank] = self._clock()
+
+    def deregister(self, rank: int):
+        self._members.pop(rank, None)
+
+    def report_failure(self, rank: int):
+        """Definitive failure (process exit): marks the member dead with no
+        grace window (stale heartbeats, by contrast, get ELASTIC_TIMEOUT)."""
+        if rank in self._members:
+            self._members[rank] = float("-inf")
+        self._reported = True
+
+    @property
+    def np(self) -> int:
+        return len(self._members)
+
+    def live_members(self):
+        now = self._clock()
+        return [r for r, t in self._members.items()
+                if now - t <= self.timeout]
+
+    def dead_members(self):
+        now = self._clock()
+        return [r for r, t in self._members.items()
+                if now - t > self.timeout]
+
+    # ---- decision (ref manager watch loop) ----
+    def decide(self, all_done: bool = False) -> ElasticStatus:
+        if all_done:
+            return ElasticStatus.COMPLETED
+        dead = self.dead_members()
+        if not dead:
+            self._grace_start = None
+            return ElasticStatus.NORMAL
+        # grace window: transient (stale-heartbeat) failures get
+        # ELASTIC_TIMEOUT to come back; reported process exits do not
+        if not self._reported:
+            now = self._clock()
+            if self._grace_start is None:
+                self._grace_start = now
+            if now - self._grace_start < self.timeout:
+                return ElasticStatus.HOLD
+        live = len(self.live_members())
+        if live >= self.min_np:
+            return ElasticStatus.RESTART       # relaunch at the scaled size
+        if self.restarts < self.max_restart:
+            return ElasticStatus.RESTART
+        return ElasticStatus.EXIT
+
+    def scaled_np(self) -> int:
+        """Target world size for the next launch: live members clamped to
+        [min_np, max_np] (scale down on loss, up to max on recovery)."""
+        live = len(self.live_members())
+        return max(self.min_np, min(self.max_np, live if live > 0
+                                    else self.min_np))
+
+    def on_restart(self):
+        self.restarts += 1
+        self._members.clear()
+        self._grace_start = None
+        self._reported = False
+
+
+from .manager import ELASTIC_AUTO_PARALLEL_EXIT_CODE  # noqa

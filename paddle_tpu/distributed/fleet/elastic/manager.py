@@ -1,0 +1,2 @@
+"""Elastic constants + helpers (ref fleet/elastic/manager.py)."""
+ELASTIC_AUTO_PARALLEL_EXIT_CODE = 101

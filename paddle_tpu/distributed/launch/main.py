@@ -100,9 +100,15 @@ class CollectiveController:
             self.containers.append(Container(rank, cmd, env, self.args.log_dir))
 
     def run(self):
+        from ..fleet.elastic import ElasticManager, ElasticStatus
+        n0 = self.args.nproc_per_node
+        mgr = ElasticManager(self.args.np or str(n0), timeout=10.0,
+                             max_restart=self.args.max_restart)
+
         self.build_pod()
         for c in self.containers:
             c.start()
+            mgr.register(c.rank)
         print(f"[launch] started {len(self.containers)} trainer(s); "
               f"logs in {self.args.log_dir}")
 
@@ -114,33 +120,43 @@ class CollectiveController:
         signal.signal(signal.SIGINT, handler)
         signal.signal(signal.SIGTERM, handler)
 
-        restarts = 0
         while True:
             time.sleep(1)
+            # process liveness IS the heartbeat (ref: etcd heartbeats)
+            for c in self.containers:
+                if c.alive():
+                    mgr.heartbeat(c.rank)
             dead = [c for c in self.containers if not c.alive()]
-            if not dead:
-                continue
             failed = [c for c in dead if c.returncode != 0]
             if not failed and len(dead) == len(self.containers):
                 print("[launch] all trainers finished")
                 return 0
-            if failed:
-                if self.args.elastic_level > 0 and restarts < self.args.max_restart:
-                    restarts += 1
-                    print(f"[launch] trainer failed (rc={failed[0].returncode}); "
-                          f"elastic relaunch {restarts}/{self.args.max_restart}")
-                    for c in self.containers:
-                        c.terminate()
-                    self.containers = []
-                    self.build_pod()
-                    for c in self.containers:
-                        c.start()
-                else:
-                    print(f"[launch] trainer {failed[0].rank} failed with "
-                          f"rc={failed[0].returncode}; terminating pod")
-                    for c in self.containers:
-                        c.terminate()
-                    return failed[0].returncode or 1
+            if not failed:
+                continue
+            for c in failed:
+                mgr.report_failure(c.rank)
+            status = mgr.decide()
+            if status == ElasticStatus.RESTART and self.args.elastic_level > 0 \
+                    and mgr.restarts < self.args.max_restart:
+                new_n = mgr.scaled_np() if self.args.np else n0
+                mgr.on_restart()
+                print(f"[launch] trainer failed (rc={failed[0].returncode}); "
+                      f"elastic relaunch {mgr.restarts}/{self.args.max_restart} "
+                      f"with np={new_n}")
+                for c in self.containers:
+                    c.terminate()
+                self.containers = []
+                self.args.nproc_per_node = new_n
+                self.build_pod()
+                for c in self.containers:
+                    c.start()
+                    mgr.register(c.rank)
+            else:
+                print(f"[launch] trainer {failed[0].rank} failed with "
+                      f"rc={failed[0].returncode}; terminating pod")
+                for c in self.containers:
+                    c.terminate()
+                return failed[0].returncode or 1
 
 
 def launch():
@@ -160,6 +176,10 @@ def launch():
     parser.add_argument("--elastic_level", type=int,
                         default=int(os.getenv("PADDLE_ELASTIC_LEVEL", "0")))
     parser.add_argument("--max_restart", type=int, default=3)
+    parser.add_argument("--np", default=os.getenv("PADDLE_ELASTIC_NP"),
+                        help="elastic world-size range 'min:max' (ref elastic "
+                             "np): on member loss the pod relaunches scaled "
+                             "down to the live count within the range")
     parser.add_argument("--module", "-m", action="store_true",
                         help="run training script as a module")
     parser.add_argument("training_script")

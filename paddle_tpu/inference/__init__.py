@@ -1,0 +1,191 @@
+"""paddle.inference — deployment predictor API.
+
+Reference parity: `paddle/fluid/inference/api/analysis_predictor.cc` +
+`python/paddle/inference/__init__.py` (Config, create_predictor, named
+input/output handles).
+
+TPU-native design: the "analysis + IR pass pipeline + engine subgraphs" of the
+reference collapses into XLA — a saved model is a serialized StableHLO program
+(`jit.save` / `static.save_inference_model` artifact), and the Predictor is a
+thin handle layer over the deserialized executable.  TensorRT/ONNXRuntime/
+mkldnn toggles are accepted for API compatibility and are inert: XLA:TPU is the
+one engine.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Int8 = 2
+    Bfloat16 = 3
+
+
+class PlaceType:
+    UNK = -1
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM = 3
+
+
+class Config:
+    """ref inference.Config: model paths + engine knobs (engine knobs are inert
+    on TPU — XLA owns compilation)."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if prog_file is not None and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[:-len(".pdmodel")]
+        self._prefix = prog_file
+        self._params_file = params_file
+        self._flags: Dict[str, object] = {}
+
+    def set_prog_file(self, path):
+        self._prefix = path[:-len(".pdmodel")] if path.endswith(".pdmodel") \
+            else path
+
+    def set_params_file(self, path):
+        self._params_file = path
+
+    def prog_file(self):
+        return (self._prefix or "") + ".pdmodel"
+
+    def params_file(self):
+        return self._params_file or (self._prefix or "") + ".pdiparams"
+
+    # engine/placement knobs — accepted, inert (XLA owns them on TPU)
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._flags["gpu"] = device_id
+
+    def enable_xpu(self, *a, **k):
+        self._flags["xpu"] = True
+
+    def disable_gpu(self):
+        self._flags.pop("gpu", None)
+
+    def enable_tensorrt_engine(self, *a, **k):
+        self._flags["trt"] = True
+
+    def enable_mkldnn(self):
+        self._flags["mkldnn"] = True
+
+    def switch_ir_optim(self, flag=True):
+        self._flags["ir_optim"] = flag
+
+    def enable_memory_optim(self, flag=True):
+        self._flags["memory_optim"] = flag
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._flags["threads"] = n
+
+    def summary(self):
+        return f"Config(prefix={self._prefix}, flags={self._flags})"
+
+
+class Tensor_:
+    """Named input/output handle (ref ZeroCopyTensor / PaddleTensor)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._data = None
+
+    def copy_from_cpu(self, arr):
+        self._data = np.asarray(arr)
+
+    def copy_to_cpu(self):
+        return self._data
+
+    def reshape(self, shape):
+        if self._data is not None:
+            self._data = self._data.reshape(shape)
+
+    def shape(self):
+        return list(self._data.shape) if self._data is not None else []
+
+
+class Predictor:
+    """ref AnalysisPredictor: run a saved program with named handles."""
+
+    def __init__(self, config: Config):
+        from jax import export as jax_export
+        self._config = config
+        with open(config.prog_file(), "rb") as f:
+            self._exported = jax_export.deserialize(f.read())
+        self._in_names: List[str] = []
+        self._out_names: List[str] = []
+        meta = {}
+        params_path = config.params_file()
+        if os.path.exists(params_path):
+            with open(params_path, "rb") as f:
+                try:
+                    meta = pickle.load(f)
+                except Exception:
+                    meta = {}
+        n_in = len(self._exported.in_avals)
+        n_out = len(self._exported.out_avals)
+        self._in_names = list(meta.get("feed_names") or
+                              [f"input_{i}" for i in range(n_in)])[:n_in]
+        if len(self._in_names) < n_in:
+            self._in_names += [f"input_{i}"
+                               for i in range(len(self._in_names), n_in)]
+        self._out_names = [f"output_{i}" for i in range(n_out)]
+        self._inputs = {n: Tensor_(n) for n in self._in_names}
+        self._outputs = {n: Tensor_(n) for n in self._out_names}
+
+    def get_input_names(self):
+        return list(self._in_names)
+
+    def get_output_names(self):
+        return list(self._out_names)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def get_input_tensor(self, name):
+        return self._inputs[name]
+
+    def get_output_handle(self, name):
+        return self._outputs[name]
+
+    def get_output_tensor(self, name):
+        return self._outputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Batched inference: one XLA executable call (compiled once)."""
+        import jax.numpy as jnp
+        if inputs is not None:
+            for n, a in zip(self._in_names, inputs):
+                self._inputs[n].copy_from_cpu(a)
+        args = [jnp.asarray(self._inputs[n]._data) for n in self._in_names]
+        outs = self._exported.call(*args)
+        outs = outs if isinstance(outs, (tuple, list)) else (outs,)
+        for n, o in zip(self._out_names, outs):
+            self._outputs[n]._data = np.asarray(o)
+        if inputs is not None:
+            return [self._outputs[n]._data for n in self._out_names]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+def get_version():
+    from .. import __version__
+    return __version__
+
+
+def convert_to_mixed_precision(*a, **k):
+    raise NotImplementedError(
+        "convert_to_mixed_precision: on TPU use paddle.amp at train time or "
+        "export the program in bfloat16 (GPU pass-pipeline concept)")
+
+
+__all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
+           "PlaceType", "get_version", "convert_to_mixed_precision"]

@@ -99,8 +99,15 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k, max_seqle
         out = jnp.einsum("hqk,khd->qhd", p, v.astype(jnp.float32))
         return out.astype(q.dtype)
 
+    import numpy as _np
     D = (query._data if isinstance(query, Tensor) else query).shape[-1]
-    use_kernel = _on_tpu() and D in (64, 128, 256) and dropout == 0.0
+    cuq = cu_seqlens_q._data if isinstance(cu_seqlens_q, Tensor) else cu_seqlens_q
+    cuk = cu_seqlens_k._data if isinstance(cu_seqlens_k, Tensor) else cu_seqlens_k
+    # the kernel masks causality in packed-global coordinates, which equals the
+    # reference's per-segment local causality only for self-attention layouts
+    same_layout = _np.array_equal(_np.asarray(cuq), _np.asarray(cuk))
+    use_kernel = _on_tpu() and D in (64, 128, 256) and dropout == 0.0 and \
+        (same_layout or not causal)
     out = apply("flash_attn_unpadded", kernel_path if use_kernel else f,
                 query, key, value, cu_seqlens_q, cu_seqlens_k)
     return out, None

@@ -1,0 +1,24 @@
+"""paddle.onnx — export surface.
+
+Scope decision (recorded per VERDICT round-1 item 10): the reference's
+`paddle.onnx.export` delegates to the external paddle2onnx package, which
+converts ProgramDesc protobufs — an IR this framework intentionally does not
+have.  The TPU-native serialized program format is StableHLO (via
+`paddle.jit.save` / `paddle.static.save_inference_model`), which is the
+portable interchange format of the XLA ecosystem and is what TPU serving
+stacks consume.  ONNX interchange, if needed, should go StableHLO -> ONNX via
+community converters outside this framework.
+"""
+from __future__ import annotations
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """ref onnx/export.py — see module docstring for the scope decision."""
+    raise NotImplementedError(
+        "paddle.onnx.export is descoped on TPU: the deployment format is "
+        "StableHLO — use paddle.jit.save(layer, path, input_spec) and serve "
+        "the .pdmodel with paddle.inference.Predictor; convert StableHLO to "
+        "ONNX externally if interchange is required")
+
+
+__all__ = ["export"]

@@ -255,7 +255,8 @@ def _cp_loss(params, tokens, labels, config, cfg: MeshConfig, mesh):
     head = params["wte"].T if config.tie_word_embeddings else params["lm_head"]
     loss = _vp_ce(h, head, labels, mesh, cfg)
     if config.moe_num_experts > 0:
-        loss = loss + config.moe_aux_weight * aux
+        # psum summed cp per-shard aux values; mean matches the dense scale
+        loss = loss + config.moe_aux_weight * aux / cp
     return loss
 
 

@@ -83,7 +83,11 @@ def ring_attention_local(q, k, v, axis_name: str, cp: int, causal: bool = True,
     (kf, vf, o, m, l), _ = jax.lax.scan(step, (k, v, o0, m0, l0),
                                         jnp.arange(cp))
     out = o / jnp.maximum(l, 1e-30)[..., None]
-    return jnp.transpose(out.astype(q.dtype), (0, 2, 1, 3))
+    out = jnp.transpose(out.astype(q.dtype), (0, 2, 1, 3))
+    # named so remat_policy_save_attention saves the ring output: block replay
+    # under cfg.remat must not re-run the cp-step scan + ppermutes
+    from jax.ad_checkpoint import checkpoint_name
+    return checkpoint_name(out, "flash_out")
 
 
 def ring_attention(q, k, v, mesh, axis_name: str = "cp", causal: bool = True,

@@ -1,0 +1,236 @@
+"""paddle.quantization — QAT (fake-quant training) and PTQ (observer calibration).
+
+Reference parity: `python/paddle/quantization/` (QuantConfig, QAT, PTQ,
+quanters/observers) and `quantization/imperative/qat.py`
+(ImperativeQuantAware).
+
+TPU-native design: fake-quantization is a straight-through-estimator op pair
+(quantize -> dequantize with stop_gradient on the rounding), which XLA fuses
+into the surrounding matmul; `convert()` produces layers holding int8 weights +
+scales whose forward dequantizes into the bf16 MXU matmul (weight-only int8 —
+the TPU-serving quantization form).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+from ..nn.layer.layers import Layer
+
+
+def fake_quant(x, scale, bits=8):
+    """Symmetric fake-quant with straight-through estimator (ref
+    FakeQuanterWithAbsMax)."""
+    qmax = 2.0 ** (bits - 1) - 1
+
+    def f(a, s):
+        s = jnp.maximum(s, 1e-8)
+        q = jnp.clip(jnp.round(a / s * qmax), -qmax, qmax) * s / qmax
+        # STE: forward quantized, gradient of identity
+        return a + jax.lax.stop_gradient(q - a)
+    return apply("fake_quant", f, x, scale)
+
+
+class AbsmaxObserver:
+    """ref observers.AbsmaxObserver: tracks max |x| for the scale."""
+
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+        self._absmax = 0.0
+
+    def observe(self, x):
+        data = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        self._absmax = max(self._absmax, float(jnp.max(jnp.abs(data))))
+
+    def scale(self):
+        return self._absmax if self._absmax > 0 else 1.0
+
+
+class FakeQuanterWithAbsMaxObserver(Layer):
+    """QAT quanter: running-absmax scale + STE fake quant (ref
+    quanters/abs_max.py)."""
+
+    def __init__(self, moving_rate=0.9, quant_bits=8, name=None, **kwargs):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.moving_rate = moving_rate
+        self._scale = 1.0
+        self._initialized = False
+
+    def forward(self, x):
+        data = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        cur = float(jnp.max(jnp.abs(data)))
+        if self.training:
+            if not self._initialized:
+                self._scale = max(cur, 1e-8)
+                self._initialized = True
+            else:
+                r = self.moving_rate
+                self._scale = r * self._scale + (1 - r) * cur
+        return fake_quant(x, Tensor(jnp.asarray(self._scale, jnp.float32)),
+                          self.quant_bits)
+
+
+class QuantConfig:
+    """ref config.QuantConfig: which layers get which quanters."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._types = []
+
+    def add_type_config(self, layer_types, activation=None, weight=None):
+        if not isinstance(layer_types, (list, tuple)):
+            layer_types = [layer_types]
+        self._types.append((tuple(layer_types), activation, weight))
+
+    def _quanters_for(self, layer):
+        for types, act, w in self._types:
+            if isinstance(layer, types):
+                return act, w
+        return self.activation, self.weight
+
+
+class QuantedLinear(Layer):
+    """Linear wrapped with weight/activation fake-quant (QAT sim)."""
+
+    def __init__(self, linear, act_quanter, wt_quanter):
+        super().__init__()
+        self._inner = linear
+        self.act_quanter = act_quanter() if callable(act_quanter) else act_quanter
+        self.wt_quanter = wt_quanter() if callable(wt_quanter) else wt_quanter
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+        if self.act_quanter is not None:
+            x = self.act_quanter(x)
+        w = self._inner.weight
+        if self.wt_quanter is not None:
+            w = self.wt_quanter(w)
+        return F.linear(x, w, self._inner.bias)
+
+
+class Int8Linear(Layer):
+    """Deployment form: int8 weights + f32 scale, dequantized into the MXU
+    matmul (weight-only int8)."""
+
+    def __init__(self, linear, bits=8):
+        super().__init__()
+        qmax = 2.0 ** (bits - 1) - 1
+        w = linear.weight._data
+        scale = jnp.maximum(jnp.max(jnp.abs(w)), 1e-8)
+        self.qweight = jnp.clip(jnp.round(w / scale * qmax), -qmax,
+                                qmax).astype(jnp.int8)
+        self.scale = float(scale / qmax)
+        self.bias = linear.bias
+
+    def forward(self, x):
+        qw, s = self.qweight, self.scale
+
+        def f(a, *b):
+            out = jnp.matmul(a, qw.astype(a.dtype)) * s
+            if b:
+                out = out + b[0]
+            return out
+        args = (x,) + ((self.bias,) if self.bias is not None else ())
+        return apply("int8_linear", f, *args)
+
+
+def _swap_linears(model, make):
+    from ..nn.layer.common import Linear
+    # Layer tree walk via _sub_layers
+    for name, sub in list(getattr(model, "_sub_layers", {}).items()):
+        if isinstance(sub, Linear):
+            model._sub_layers[name] = make(sub)
+        else:
+            _swap_linears(sub, make)
+    return model
+
+
+class QAT:
+    """Quantization-aware training driver (ref qat.py QAT)."""
+
+    def __init__(self, config: QuantConfig = None):
+        self._config = config or QuantConfig(
+            activation=FakeQuanterWithAbsMaxObserver,
+            weight=FakeQuanterWithAbsMaxObserver)
+
+    def quantize(self, model, inplace=True):
+        cfg = self._config
+
+        def make(lin):
+            act, w = cfg._quanters_for(lin)
+            return QuantedLinear(lin, act, w)
+        return _swap_linears(model, make)
+
+    def convert(self, model, inplace=True):
+        def unmake(q):
+            return Int8Linear(q._inner) if isinstance(q, QuantedLinear) else q
+
+        for name, sub in list(getattr(model, "_sub_layers", {}).items()):
+            if isinstance(sub, QuantedLinear):
+                model._sub_layers[name] = Int8Linear(sub._inner)
+            else:
+                self.convert(sub)
+        return model
+
+
+class PTQ:
+    """Post-training quantization: observe activations on calibration data,
+    then convert to int8-weight layers (ref ptq.py PTQ)."""
+
+    def __init__(self, config: QuantConfig = None):
+        self._config = config
+        self._observers = []
+
+    def quantize(self, model, inplace=True):
+        ptq = self
+
+        class _Observed(Layer):
+            def __init__(self, lin):
+                super().__init__()
+                self._inner = lin
+                self.observer = AbsmaxObserver()
+                ptq._observers.append(self.observer)
+
+            def forward(self, x):
+                self.observer.observe(x)
+                return self._inner(x)
+
+        return _swap_linears(model, _Observed)
+
+    def convert(self, model, inplace=True):
+        for name, sub in list(getattr(model, "_sub_layers", {}).items()):
+            if hasattr(sub, "observer") and hasattr(sub, "_inner"):
+                model._sub_layers[name] = Int8Linear(sub._inner)
+            else:
+                self.convert(sub)
+        return model
+
+
+class ImperativeQuantAware:
+    """ref quantization/imperative/qat.py ImperativeQuantAware."""
+
+    def __init__(self, quantizable_layer_type=None, weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_bits=8, activation_bits=8, moving_rate=0.9, **kwargs):
+        self._qat = QAT(QuantConfig(
+            activation=lambda: FakeQuanterWithAbsMaxObserver(
+                moving_rate, activation_bits),
+            weight=lambda: FakeQuanterWithAbsMaxObserver(
+                moving_rate, weight_bits)))
+
+    def quantize(self, model):
+        return self._qat.quantize(model)
+
+    def save_quantized_model(self, model, path, input_spec=None, **config):
+        from ..jit import save
+        converted = self._qat.convert(model)
+        save(converted, path, input_spec=input_spec)
+
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "ImperativeQuantAware", "fake_quant",
+           "AbsmaxObserver", "FakeQuanterWithAbsMaxObserver", "QuantedLinear",
+           "Int8Linear"]

@@ -1,0 +1,136 @@
+"""ElasticManager decisions + elastic launch scale-down + DistributedStrategy
+-> MeshConfig lowering (ref fleet/elastic/manager.py:126,
+fleet/base/distributed_strategy.py:121)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                  ElasticStatus, parse_np)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_parse_np():
+    assert parse_np("2:4") == (2, 4)
+    assert parse_np("3") == (3, 3)
+    assert parse_np(4) == (4, 4)
+    with pytest.raises(ValueError):
+        parse_np("4:2")
+
+
+def test_manager_normal_and_reported_failure():
+    clk = FakeClock()
+    mgr = ElasticManager("2:4", timeout=10.0, clock=clk)
+    for r in range(4):
+        mgr.register(r)
+    assert mgr.decide() == ElasticStatus.NORMAL
+    mgr.report_failure(3)
+    assert mgr.decide() == ElasticStatus.RESTART  # no grace for process exit
+    assert mgr.scaled_np() == 3                   # scale down to live count
+
+
+def test_manager_stale_heartbeat_grace_then_restart():
+    clk = FakeClock()
+    mgr = ElasticManager("1:2", timeout=10.0, clock=clk)
+    mgr.register(0)
+    mgr.register(1)
+    clk.t = 11.0
+    mgr.heartbeat(0)                              # rank 1 goes silent
+    assert mgr.decide() == ElasticStatus.HOLD     # inside grace window
+    clk.t = 22.0
+    mgr.heartbeat(0)
+    assert mgr.decide() == ElasticStatus.RESTART
+    assert mgr.scaled_np() == 1
+
+
+def test_manager_exit_when_below_min_and_exhausted():
+    clk = FakeClock()
+    mgr = ElasticManager("2:2", timeout=1.0, max_restart=1, clock=clk)
+    mgr.register(0)
+    mgr.register(1)
+    mgr.report_failure(0)
+    mgr.report_failure(1)
+    assert mgr.decide() == ElasticStatus.RESTART  # retry budget left
+    mgr.on_restart()
+    mgr.register(0)
+    mgr.register(1)
+    mgr.report_failure(0)
+    mgr.report_failure(1)
+    assert mgr.decide() == ElasticStatus.EXIT
+
+
+def test_elastic_launch_scales_down(tmp_path):
+    """rank>=1 always dies -> elastic relaunch with np=1 -> success."""
+    script = tmp_path / "flaky.py"
+    script.write_text(
+        "import os, sys\n"
+        "rank = int(os.environ['PADDLE_TRAINER_ID'])\n"
+        "world = int(os.environ['PADDLE_TRAINERS_NUM'])\n"
+        "if rank >= 1:\n"
+        "    sys.exit(1)\n"
+        "print(f'SURVIVOR world={world}', flush=True)\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    log_dir = str(tmp_path / "logs")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--np", "1:2", "--elastic_level", "1",
+         "--log_dir", log_dir, str(script)],
+        env=env, capture_output=True, text=True, timeout=120,
+        cwd=str(tmp_path))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "elastic relaunch 1/" in proc.stdout and "np=1" in proc.stdout
+    logs = "".join(open(os.path.join(log_dir, f), errors="replace").read()
+                   for f in os.listdir(log_dir))
+    assert "SURVIVOR world=1" in logs
+
+
+def test_distributed_strategy_to_mesh_config():
+    import paddle_tpu.distributed.fleet as fleet
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2,
+                        "sharding_degree": 1, "sep_degree": 1,
+                        "mp_configs": {"sequence_parallel": True},
+                        "pp_configs": {}}
+    s.recompute = True
+    s.sharding = True
+    s.sharding_configs = {"sharding_degree": 2, "stage": 2, "offload": False,
+                          "accumulate_steps": 1}
+    s.pipeline = True
+    s.pipeline_configs = {"accumulate_steps": 4, "micro_batch_size": 1,
+                          "schedule_mode": "1F1B"}
+    mc = s.to_mesh_config()
+    assert (mc.dp, mc.pp, mc.sharding, mc.mp) == (2, 2, 2, 2)
+    assert mc.sharding_stage == 2
+    assert mc.micro_batches == 4
+    assert mc.sequence_parallel and mc.remat
+    assert mc.size == 16
+
+
+def test_engine_accepts_strategy():
+    import jax
+    from paddle_tpu.distributed.auto_parallel import Engine
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.models.gpt import gpt_tiny
+    s = DistributedStrategy()
+    s.hybrid_configs["dp_degree"] = 2
+    s.hybrid_configs["mp_degree"] = 2
+    eng = Engine(config=gpt_tiny(64), strategy=s, devices=jax.devices()[:4],
+                 seed=0)
+    assert eng.trainer.cfg.dp == 2 and eng.trainer.cfg.mp == 2
+    rng = np.random.RandomState(0)
+    tok = rng.randint(0, 256, (8, 64)).astype(np.int32)
+    loss = float(eng.trainer.train_step(tok, np.roll(tok, -1, 1)))
+    assert np.isfinite(loss)

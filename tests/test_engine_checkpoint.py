@@ -89,3 +89,35 @@ def test_checkpoint_without_optimizer(tmp_path):
     la = float(a.trainer.eval_loss(tok, lab))
     lb = float(b.trainer.eval_loss(tok, lab))
     np.testing.assert_allclose(la, lb, rtol=1e-5)
+
+
+def test_load_optimizer_mismatch_paths(tmp_path):
+    """Checkpoint without optimizer loads with default flags and vice versa."""
+    cfg = gpt_tiny(64)
+    tok, lab = _data(cfg, n=8)
+    a = Engine(config=cfg, mesh_config=MeshConfig(), devices=jax.devices()[:1],
+               seed=3)
+    a.trainer.train_step(tok, lab)
+    p1 = str(tmp_path / "no_opt")
+    a.save(p1, training=False)
+    b = Engine(config=cfg, mesh_config=MeshConfig(), devices=jax.devices()[:1],
+               seed=9)
+    b.load(p1)          # load_optimizer=True but checkpoint has no opt: fine
+    np.testing.assert_allclose(float(b.trainer.eval_loss(tok, lab)),
+                               float(a.trainer.eval_loss(tok, lab)), rtol=1e-5)
+    p2 = str(tmp_path / "with_opt")
+    a.save(p2, training=True)
+    c = Engine(config=cfg, mesh_config=MeshConfig(), devices=jax.devices()[:1],
+               seed=11)
+    c.load(p2, load_optimizer=False)   # opt present but skipped: fine
+    np.testing.assert_allclose(float(c.trainer.eval_loss(tok, lab)),
+                               float(a.trainer.eval_loss(tok, lab)), rtol=1e-5)
+
+
+def test_predict_includes_tail_batch():
+    cfg = gpt_tiny(64)
+    tok, _ = _data(cfg, n=10)
+    eng = Engine(config=cfg, mesh_config=MeshConfig(),
+                 devices=jax.devices()[:1], seed=0)
+    out = eng.predict(tok, batch_size=4)
+    assert out.shape[0] == 10

@@ -1,0 +1,125 @@
+"""paddle.inference Predictor + paddle.quantization QAT/PTQ
+(ref analysis_predictor.cc, quantization/imperative/qat.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _model():
+    paddle.framework.random.seed(7)
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+def _saved_model(tmp_path):
+    from paddle_tpu.static import InputSpec
+    model = _model()
+    path = str(tmp_path / "m")
+    paddle.jit.save(model, path, input_spec=[InputSpec([4, 8], "float32")])
+    return model, path
+
+
+def test_predictor_serves_saved_model(tmp_path):
+    model, path = _saved_model(tmp_path)
+    from paddle_tpu.inference import Config, create_predictor
+    cfg = Config(path + ".pdmodel", path + ".pdiparams")
+    pred = create_predictor(cfg)
+    names = pred.get_input_names()
+    assert len(names) == 1
+    x = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+    h = pred.get_input_handle(names[0])
+    h.copy_from_cpu(x)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    ref = model(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_predictor_run_list_api(tmp_path):
+    _, path = _saved_model(tmp_path)
+    from paddle_tpu.inference import Config, create_predictor
+    pred = create_predictor(Config(path))
+    x = np.ones((4, 8), np.float32)
+    outs = pred.run([x])
+    assert outs[0].shape == (4, 4)
+
+
+def test_qat_trains_and_converts():
+    from paddle_tpu.quantization import QAT, Int8Linear, QuantedLinear
+    import paddle_tpu.nn.functional as F
+    model = _model()
+    qat = QAT()
+    model = qat.quantize(model)
+    assert any(isinstance(l, QuantedLinear)
+               for l in model._sub_layers.values())
+    opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                 parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 8).astype(np.float32)
+    Y = rng.randint(0, 4, (32,)).astype(np.int64)
+    losses = []
+    for _ in range(15):
+        loss = F.cross_entropy(model(paddle.to_tensor(X)), paddle.to_tensor(Y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0], losses[::5]
+
+    converted = qat.convert(model)
+    assert any(isinstance(l, Int8Linear)
+               for l in converted._sub_layers.values())
+    out_q = converted(paddle.to_tensor(X)).numpy()
+    assert np.isfinite(out_q).all()
+
+
+def test_ptq_calibrate_convert_close_to_fp():
+    from paddle_tpu.quantization import PTQ, Int8Linear
+    model = _model()
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, 8).astype(np.float32)
+    fp_out = model(paddle.to_tensor(X)).numpy()
+    ptq = PTQ()
+    model = ptq.quantize(model)
+    model(paddle.to_tensor(X))          # calibration pass
+    model = ptq.convert(model)
+    assert any(isinstance(l, Int8Linear)
+               for l in model._sub_layers.values())
+    q_out = model(paddle.to_tensor(X)).numpy()
+    # int8 weight-only quantization: small relative error vs fp
+    rel = np.abs(q_out - fp_out).max() / (np.abs(fp_out).max() + 1e-6)
+    assert rel < 0.05, rel
+
+
+def test_fake_quant_ste_gradient():
+    from paddle_tpu.quantization import fake_quant
+    x = paddle.to_tensor(np.linspace(-1, 1, 16).astype(np.float32))
+    x.stop_gradient = False
+    y = fake_quant(x, paddle.to_tensor(np.float32(1.0)))
+    # values land on the int8 grid
+    q = y.numpy() * 127
+    np.testing.assert_allclose(q, np.round(q), atol=1e-4)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones(16))  # STE identity
+
+
+def test_int8_quantized_model_serves_through_predictor(tmp_path):
+    from paddle_tpu.quantization import ImperativeQuantAware
+    from paddle_tpu.static import InputSpec
+    model = _model()
+    iqa = ImperativeQuantAware()
+    model = iqa.quantize(model)
+    model(paddle.to_tensor(np.ones((4, 8), np.float32)))   # init scales
+    path = str(tmp_path / "q")
+    iqa.save_quantized_model(model, path,
+                             input_spec=[InputSpec([4, 8], "float32")])
+    from paddle_tpu.inference import Config, create_predictor
+    pred = create_predictor(Config(path))
+    outs = pred.run([np.ones((4, 8), np.float32)])
+    assert np.isfinite(outs[0]).all()
+
+
+def test_onnx_export_descope_message():
+    with pytest.raises(NotImplementedError, match="StableHLO"):
+        paddle.onnx.export(_model(), "x")
