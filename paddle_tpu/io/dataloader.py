@@ -1,16 +1,25 @@
 """DataLoader (reference: `python/paddle/io/reader.py:216`).
 
-Multiprocess workers + prefetch: worker processes produce numpy batches over a
-`multiprocessing` queue (the reference's shared-mem mmap allocator path); the main
-process converts to device Tensors.  num_workers=0 runs synchronously in-process, like
-the reference.  A background prefetch thread keeps `prefetch_factor` batches in flight
-so host→HBM transfer overlaps step compute (AsyncLoader parity).
+num_workers>0 with use_shared_memory=True forks real worker PROCESSES that
+push collated batches through a native C++ shared-memory ring per worker
+(`io/csrc/shm_ring.cc` — the reference's mmap_allocator + C++ blocking-queue
+path); the main process pops in round-robin order and converts to device
+Tensors.  Without shared memory (or if the toolchain is unavailable, or the
+dataset doesn't pickle) a prefetch thread keeps `prefetch_factor` batches in
+flight.  num_workers=0 runs synchronously in-process, like the reference.
+
+Workers are SPAWNED (JAX's XLA runtime is not fork-safe), so like the
+reference on spawn platforms, scripts using num_workers>0 must guard their
+entry point with `if __name__ == "__main__":`.
 """
 from __future__ import annotations
 
 import itertools
+import multiprocessing as _mp
+import os
 import queue as _queue
 import threading
+import traceback
 from typing import Optional
 
 import numpy as np
@@ -77,6 +86,7 @@ class DataLoader:
         self.prefetch_factor = prefetch_factor
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
+        self.use_shared_memory = use_shared_memory
         self._iterable_ds = isinstance(dataset, IterableDataset)
         if self._iterable_ds:
             self.batch_size = batch_size
@@ -133,7 +143,7 @@ class DataLoader:
 
         def producer():
             try:
-                if self._iterable_ds:
+                if self._iterable_ds or self.batch_sampler is None:
                     for item in self._iter_sync():
                         q.put(item)
                 else:
@@ -155,7 +165,103 @@ class DataLoader:
         if err:
             raise err[0]
 
+    # ---- true multiprocess workers over C++ shm rings ----
+    def _worker_loop(self, wid, ring_name, assigned):
+        """Runs in the spawned worker process: build assigned batches, push
+        through this worker's shm ring."""
+        from .shm_ring import ShmRing
+        global _worker_info
+        _worker_info = WorkerInfo(wid, self.num_workers, self.dataset)
+        ring = None
+        try:
+            ring = ShmRing(ring_name, create=False)
+            if self.worker_init_fn:
+                self.worker_init_fn(wid)
+            for indices in assigned:
+                batch = [self.dataset[i] for i in indices]
+                ring.put(self.collate_fn(batch))
+        except BaseException:
+            if ring is not None:
+                try:
+                    ring.put({"__dataloader_worker_error__":
+                              traceback.format_exc()})
+                except Exception:
+                    pass
+        finally:
+            if ring is not None:
+                ring.close_producer()
+            os._exit(0)
+
+    _ring_counter = itertools.count()
+
+    def _iter_multiprocess(self):
+        from .shm_ring import TIMEOUT, ShmRing
+        nw = self.num_workers
+        batches = list(self.batch_sampler)
+        cap = max(16 << 20, (self.prefetch_factor or 2) * 8 << 20)
+        # unique per iterator: concurrent iterators/loaders must not collide
+        # (ring_create clobbers an existing segment of the same name)
+        tag = f"pt_dl_{os.getpid()}_{next(DataLoader._ring_counter)}"
+        rings = [ShmRing(f"{tag}_{w}", capacity=cap) for w in range(nw)]
+        # spawn, not fork: the parent's XLA runtime is live and JAX is not
+        # fork-safe; spawned children import fresh (dataset must pickle —
+        # __iter__ pre-checks and falls back to the threaded path otherwise)
+        ctx = _mp.get_context("spawn")
+        procs = []
+        try:
+            for w in range(nw):
+                assigned = batches[w::nw]
+                p = ctx.Process(target=self._worker_loop,
+                                args=(w, rings[w].name, assigned), daemon=True)
+                p.start()
+                procs.append(p)
+            timeout_ms = int(self.timeout * 1000) if self.timeout else -1
+            for i in range(len(batches)):
+                ring = rings[i % nw]
+                proc = procs[i % nw]
+                while True:
+                    # bounded poll so a dead worker (OOM-kill, attach failure)
+                    # surfaces as an error instead of an infinite hang
+                    obj = ring.get(timeout_ms=1000 if timeout_ms < 0
+                                   else min(1000, timeout_ms))
+                    if obj is not TIMEOUT:
+                        break
+                    if not proc.is_alive() and ring.size() == 0:
+                        raise RuntimeError(
+                            f"DataLoader worker {i % nw} died "
+                            f"(exitcode={proc.exitcode})")
+                    if timeout_ms >= 0:
+                        timeout_ms -= 1000
+                        if timeout_ms <= 0:
+                            raise TimeoutError(
+                                f"DataLoader worker {i % nw} timed out after "
+                                f"{self.timeout}s")
+                if isinstance(obj, dict) and "__dataloader_worker_error__" in obj:
+                    raise RuntimeError("DataLoader worker failed:\n"
+                                       + obj["__dataloader_worker_error__"])
+                yield _to_tensors(obj, self.places)
+        finally:
+            for p in procs:
+                p.terminate()
+                p.join(timeout=5)
+            for r in rings:
+                r.free()
+
+    def _picklable_for_workers(self):
+        import pickle as _pickle
+        try:
+            _pickle.dumps((self.dataset, self.collate_fn,
+                           self.worker_init_fn))
+            return True
+        except Exception:
+            return False
+
     def __iter__(self):
         if self.num_workers == 0:
             return self._iter_sync()
+        if self.use_shared_memory and not self._iterable_ds \
+                and self.batch_sampler is not None:
+            from .shm_ring import available
+            if available() and self._picklable_for_workers():
+                return self._iter_multiprocess()
         return self._iter_prefetch()
