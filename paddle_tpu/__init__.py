@@ -89,11 +89,14 @@ from .utils.dlpack import to_dlpack, from_dlpack  # noqa
 
 # ---- subpackages (paddle.nn style access) ----
 from . import amp  # noqa
+from . import audio  # noqa
 from . import autograd  # noqa
 from . import distributed  # noqa
 from . import distribution  # noqa
 from . import fft  # noqa
+from . import geometric  # noqa
 from . import signal  # noqa
+from . import text  # noqa
 from . import framework  # noqa
 from . import incubate  # noqa
 from . import io  # noqa

@@ -1,0 +1,4 @@
+"""paddle.audio — spectral features (ref python/paddle/audio/)."""
+from . import features, functional  # noqa
+
+__all__ = ["features", "functional"]

@@ -1,0 +1,139 @@
+"""paddle.audio.functional (ref python/paddle/audio/functional/functional.py):
+mel scales, filterbanks, dct, window functions, dB conversion — all jnp, so
+they compose into jitted feature pipelines.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply, _to_data
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct", "get_window"]
+
+
+def hz_to_mel(freq, htk=False):
+    scalar = isinstance(freq, (int, float))
+    f = float(freq) if scalar else _to_data(freq)
+    if htk:
+        out = 2595.0 * (jnp.log10(1.0 + jnp.asarray(f) / 700.0) if not scalar
+                        else math.log10(1.0 + f / 700.0))
+        return out if scalar else Tensor(out)
+    # Slaney scale
+    f_min, f_sp = 0.0, 200.0 / 3
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    if scalar:
+        return (min_log_mel + math.log(f / min_log_hz) / logstep
+                if f >= min_log_hz else (f - f_min) / f_sp)
+    f = jnp.asarray(f)
+    mels = (f - f_min) / f_sp
+    log_t = f >= min_log_hz
+    mels = jnp.where(log_t, min_log_mel +
+                     jnp.log(jnp.maximum(f, 1e-10) / min_log_hz) / logstep,
+                     mels)
+    return Tensor(mels)
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = isinstance(mel, (int, float))
+    m = float(mel) if scalar else jnp.asarray(_to_data(mel))
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+        return out if scalar else Tensor(out)
+    f_min, f_sp = 0.0, 200.0 / 3
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    if scalar:
+        return (min_log_hz * math.exp(logstep * (m - min_log_mel))
+                if m >= min_log_mel else f_min + f_sp * m)
+    freqs = f_min + f_sp * m
+    log_t = m >= min_log_mel
+    freqs = jnp.where(log_t, min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                      freqs)
+    return Tensor(freqs)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    lo = hz_to_mel(float(f_min), htk)
+    hi = hz_to_mel(float(f_max), htk)
+    mels = jnp.linspace(lo, hi, n_mels)
+    return mel_to_hz(Tensor(mels), htk)
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return Tensor(jnp.linspace(0.0, sr / 2.0, 1 + n_fft // 2))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """Mel filterbank [n_mels, 1 + n_fft//2] (ref compute_fbank_matrix)."""
+    f_max = f_max or sr / 2.0
+    fftfreqs = np.asarray(fft_frequencies(sr, n_fft)._data)
+    melfreqs = np.asarray(mel_frequencies(n_mels + 2, f_min, f_max, htk)._data)
+    fdiff = np.diff(melfreqs)
+    ramps = melfreqs[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0.0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (melfreqs[2:n_mels + 2] - melfreqs[:n_mels])
+        weights *= enorm[:, None]
+    return Tensor(jnp.asarray(weights.astype(np.float32)))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    def f(s):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(s, amin))
+        log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(ref_value, amin))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+        return log_spec
+    return apply("power_to_db", f, spect)
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """DCT-II matrix [n_mels, n_mfcc] (ref create_dct)."""
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[:, None]
+    dct = np.cos(np.pi / n_mels * (n + 0.5) * k)      # [n_mfcc, n_mels]
+    if norm == "ortho":
+        dct[0] *= 1.0 / math.sqrt(2.0)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(jnp.asarray(dct.T.astype(np.float32)))
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """hann/hamming/blackman/bartlett/... (ref window.py get_window)."""
+    if isinstance(window, tuple):
+        name, *args = window
+    else:
+        name, args = window, []
+    M = win_length + (0 if fftbins else -1)
+    n = jnp.arange(win_length)
+    denom = max(M, 1)
+    if name in ("hann", "hanning"):
+        w = 0.5 - 0.5 * jnp.cos(2 * jnp.pi * n / denom)
+    elif name == "hamming":
+        w = 0.54 - 0.46 * jnp.cos(2 * jnp.pi * n / denom)
+    elif name == "blackman":
+        w = (0.42 - 0.5 * jnp.cos(2 * jnp.pi * n / denom)
+             + 0.08 * jnp.cos(4 * jnp.pi * n / denom))
+    elif name == "bartlett":
+        w = 1.0 - jnp.abs(2.0 * n / denom - 1.0)
+    elif name in ("rect", "rectangular", "boxcar", "ones"):
+        w = jnp.ones(win_length)
+    elif name == "gaussian":
+        std = args[0] if args else 7.0
+        w = jnp.exp(-0.5 * ((n - M / 2.0) / std) ** 2)
+    else:
+        raise ValueError(f"unsupported window: {window!r}")
+    return Tensor(w.astype(jnp.float32))

@@ -1,0 +1,168 @@
+"""paddle.geometric — graph message passing + sampling.
+
+Reference parity: `python/paddle/geometric/` (send_u_recv/send_ue_recv/send_uv
+over `graph_send_recv`/`graph_send_ue_recv` kernels, segment ops, neighbor
+sampling + reindexing).
+
+TPU-native: message passing lowers to XLA segment reductions (one fused
+scatter each); sampling/reindex are host-side numpy (dynamic shapes are
+host-side in the reference too — the GPU kernels there serve its GPU PS
+pipeline, which is descoped; see README).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply, _to_data
+from ..incubate.segment_ops import (segment_max, segment_mean, segment_min,
+                                    segment_sum)
+
+__all__ = ["reindex_graph", "reindex_heter_graph", "sample_neighbors",
+           "segment_max", "segment_mean", "segment_min", "segment_sum",
+           "send_u_recv", "send_ue_recv", "send_uv",
+           "weighted_sample_neighbors"]
+
+_RED = {"sum": jax.ops.segment_sum, "mean": None, "max": jax.ops.segment_max,
+        "min": jax.ops.segment_min}
+
+_OPS = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+        "div": jnp.divide}
+
+
+def _reduce(msgs, dst, n, pool):
+    dst32 = dst.astype(jnp.int32)
+    if pool == "mean":
+        s = jax.ops.segment_sum(msgs, dst32, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],), msgs.dtype),
+                                  dst32, num_segments=n)
+        return s / jnp.maximum(cnt, 1.0).reshape(
+            (-1,) + (1,) * (msgs.ndim - 1))
+    out = _RED[pool](msgs, dst32, num_segments=n)
+    if pool in ("max", "min"):
+        # reference zero-fills nodes that receive no message (not +/-inf)
+        cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],), jnp.float32),
+                                  dst32, num_segments=n)
+        mask = (cnt > 0).reshape((-1,) + (1,) * (msgs.ndim - 1))
+        out = jnp.where(mask, out, 0.0).astype(msgs.dtype)
+    return out
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src], reduce into dst (ref send_u_recv / graph_send_recv)."""
+    def f(a, si, di):
+        n = out_size or a.shape[0]
+        return _reduce(a[si.astype(jnp.int32)], di, n, reduce_op)
+    return apply("send_u_recv", f, x, src_index, dst_index)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Combine node features x[src] with edge features y, reduce into dst
+    (ref send_ue_recv / graph_send_ue_recv)."""
+    mop = _OPS[message_op]
+
+    def f(a, e, si, di):
+        msgs = mop(a[si.astype(jnp.int32)], e)
+        n = out_size or a.shape[0]
+        return _reduce(msgs, di, n, reduce_op)
+    return apply("send_ue_recv", f, x, y, src_index, dst_index)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message from both endpoints (ref send_uv / graph_send_uv)."""
+    mop = _OPS[message_op]
+
+    def f(a, b, si, di):
+        return mop(a[si.astype(jnp.int32)], b[di.astype(jnp.int32)])
+    return apply("send_uv", f, x, y, src_index, dst_index)
+
+
+# fresh stream per process: every sample_neighbors call must draw different
+# neighborhoods (GraphSAGE-style training resamples each minibatch)
+_sample_rng = np.random.RandomState()
+
+
+def _sample(row, colptr, input_nodes, sample_size, eids, return_eids,
+            weight=None):
+    rown = np.asarray(_to_data(row)).astype(np.int64)
+    cptr = np.asarray(_to_data(colptr)).astype(np.int64)
+    nodes = np.asarray(_to_data(input_nodes)).astype(np.int64).reshape(-1)
+    w = None if weight is None else \
+        np.asarray(_to_data(weight)).astype(np.float64).reshape(-1)
+    ed = np.arange(len(rown), dtype=np.int64) if eids is None \
+        else np.asarray(_to_data(eids)).astype(np.int64).reshape(-1)
+    out_rows, out_eids, out_count = [], [], []
+    for v in nodes:
+        beg, end = cptr[v], cptr[v + 1]
+        idx = np.arange(beg, end)
+        if sample_size >= 0 and len(idx) > sample_size:
+            p = None if w is None else w[idx] / w[idx].sum()
+            idx = _sample_rng.choice(idx, size=sample_size, replace=False, p=p)
+        out_rows.append(rown[idx])
+        out_eids.append(ed[idx])
+        out_count.append(len(idx))
+    cat = lambda xs: (np.concatenate(xs) if xs else np.zeros(0, np.int64))  # noqa: E731
+    res = (Tensor(jnp.asarray(cat(out_rows))),
+           Tensor(jnp.asarray(np.asarray(out_count, np.int64))))
+    if return_eids:
+        return res + (Tensor(jnp.asarray(cat(out_eids))),)
+    return res
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniform neighbor sampling from CSC (ref sample_neighbors) — host-side."""
+    return _sample(row, colptr, input_nodes, sample_size, eids, return_eids)
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weight-proportional sampling (ref weighted_sample_neighbors)."""
+    return _sample(row, colptr, input_nodes, sample_size, eids, return_eids,
+                   weight=edge_weight)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Renumber a sampled subgraph to contiguous ids (ref reindex_graph)."""
+    xs = np.asarray(_to_data(x)).astype(np.int64).reshape(-1)
+    neigh = np.asarray(_to_data(neighbors)).astype(np.int64).reshape(-1)
+    cnt = np.asarray(_to_data(count)).astype(np.int64).reshape(-1)
+    # order: input nodes first, then unseen neighbors in appearance order
+    seen = {int(v): i for i, v in enumerate(xs)}
+    nodes = list(xs)
+    for v in neigh:
+        if int(v) not in seen:
+            seen[int(v)] = len(nodes)
+            nodes.append(int(v))
+    reindex_src = np.asarray([seen[int(v)] for v in neigh], np.int64)
+    reindex_dst = np.repeat(np.arange(len(xs), dtype=np.int64), cnt)
+    return (Tensor(jnp.asarray(reindex_src)),
+            Tensor(jnp.asarray(reindex_dst)),
+            Tensor(jnp.asarray(np.asarray(nodes, np.int64))))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous variant: neighbors/count are per-edge-type lists, all
+    keyed by the SAME input nodes x; discovered nodes share one id space."""
+    xs = np.asarray(_to_data(x)).astype(np.int64).reshape(-1)
+    seen = {int(v): i for i, v in enumerate(xs)}
+    nodes = list(xs)
+    srcs, dsts = [], []
+    for n_i, c_i in zip(neighbors, count):
+        neigh = np.asarray(_to_data(n_i)).astype(np.int64).reshape(-1)
+        cnt = np.asarray(_to_data(c_i)).astype(np.int64).reshape(-1)
+        for v in neigh:
+            if int(v) not in seen:
+                seen[int(v)] = len(nodes)
+                nodes.append(int(v))
+        srcs.append(Tensor(jnp.asarray(
+            np.asarray([seen[int(v)] for v in neigh], np.int64))))
+        dsts.append(Tensor(jnp.asarray(
+            np.repeat(np.arange(len(xs), dtype=np.int64), cnt))))
+    return srcs, dsts, Tensor(jnp.asarray(np.asarray(nodes, np.int64)))

@@ -1,0 +1,119 @@
+"""paddle.text (ref python/paddle/text/): ViterbiDecoder + dataset surface.
+
+The dataset classes (Imdb, Imikolov, ...) download external corpora in the
+reference; this build has no network egress, so they exist with the reference
+constructor signature and raise a clear pointer at materialization time.
+viterbi_decode / ViterbiDecoder are fully implemented (lax.scan DP).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from ..nn.layer.layers import Layer
+
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "ViterbiDecoder", "WMT14", "WMT16", "viterbi_decode"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """CRF Viterbi decoding (ref text/viterbi_decode.py, phi viterbi kernel).
+
+    potentials [B, T, N] emission scores, transition_params [N, N] (or
+    [N+2, N+2] with BOS/EOS rows when include_bos_eos_tag), lengths [B].
+    Returns (scores [B], paths [B, T]).
+    """
+    def f(emis, trans, lens):
+        B, T, N = emis.shape
+        if include_bos_eos_tag:
+            # reference layout: trans is [N+2, N+2] with BOS=N, EOS=N+1
+            bos, eos = N, N + 1
+            start = trans[bos, :N][None]                   # [1, N]
+            stop = trans[:N, eos][None]
+            tr = trans[:N, :N]
+        else:
+            start = jnp.zeros((1, N), emis.dtype)
+            stop = jnp.zeros((1, N), emis.dtype)
+            tr = trans
+        alpha0 = emis[:, 0] + start                        # [B, N]
+
+        def step(carry, t):
+            alpha, = carry
+            # scores[b, i, j] = alpha[b, i] + tr[i, j] + emis[b, t, j]
+            s = alpha[:, :, None] + tr[None] + emis[:, t][:, None, :]
+            best = jnp.argmax(s, axis=1)                   # [B, N]
+            alpha_new = jnp.max(s, axis=1)
+            valid = (t < lens)[:, None]
+            alpha_new = jnp.where(valid, alpha_new, alpha)
+            return (alpha_new,), (best, valid[:, 0])
+
+        (alpha,), (backptrs, valids) = jax.lax.scan(
+            step, (alpha0,), jnp.arange(1, T))
+        alpha_final = alpha + (stop if include_bos_eos_tag else 0.0)
+        scores = jnp.max(alpha_final, axis=-1)
+        last_tag = jnp.argmax(alpha_final, axis=-1)        # [B]
+
+        def backtrace(carry, inp):
+            tag = carry
+            bp, valid = inp                                # bp [B, N]
+            prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+            tag_new = jnp.where(valid, prev, tag)
+            return tag_new, tag
+        # walk backpointers in reverse
+        tag_first, tags_rev = jax.lax.scan(
+            backtrace, last_tag, (backptrs, valids), reverse=True)
+        paths = jnp.concatenate([tag_first[:, None],
+                                 jnp.moveaxis(tags_rev, 0, 1)], axis=1)
+        return scores, paths.astype(jnp.int64)
+    return apply("viterbi_decode", f, potentials, transition_params, lengths)
+
+
+class ViterbiDecoder(Layer):
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+class _DownloadDataset:
+    _NAME = "dataset"
+
+    def __init__(self, *args, **kwargs):
+        raise RuntimeError(
+            f"paddle.text.{type(self).__name__} downloads its corpus from the "
+            "internet in the reference; this build has no network egress. "
+            "Provide the files locally and use paddle.io.Dataset directly.")
+
+
+class Conll05st(_DownloadDataset):
+    pass
+
+
+class Imdb(_DownloadDataset):
+    pass
+
+
+class Imikolov(_DownloadDataset):
+    pass
+
+
+class Movielens(_DownloadDataset):
+    pass
+
+
+class UCIHousing(_DownloadDataset):
+    pass
+
+
+class WMT14(_DownloadDataset):
+    pass
+
+
+class WMT16(_DownloadDataset):
+    pass

@@ -1,0 +1,499 @@
+"""SqueezeNet, MobileNetV1/V3, ShuffleNetV2, GoogLeNet, InceptionV3, DenseNet
+(reference: `python/paddle/vision/models/{squeezenet,mobilenetv1,mobilenetv3,
+shufflenetv2,googlenet,inceptionv3,densenet}.py` — architectures per the
+original papers; pretrained weights are not bundled, matching a from-scratch
+framework)."""
+from ... import nn
+from ...ops.manipulation import concat, reshape, transpose
+
+
+def _conv_bn(in_c, out_c, k, stride=1, padding=0, groups=1, act="relu"):
+    layers = [nn.Conv2D(in_c, out_c, k, stride=stride, padding=padding,
+                        groups=groups, bias_attr=False),
+              nn.BatchNorm2D(out_c)]
+    if act == "relu":
+        layers.append(nn.ReLU())
+    elif act == "hardswish":
+        layers.append(nn.Hardswish())
+    elif act == "swish":
+        layers.append(nn.Swish())
+    elif act != "none":
+        raise ValueError(f"unsupported activation: {act!r}")
+    return nn.Sequential(*layers)
+
+
+# ---------------------------------------------------------------------------
+# SqueezeNet
+# ---------------------------------------------------------------------------
+
+class _Fire(nn.Layer):
+    def __init__(self, in_c, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = nn.Sequential(nn.Conv2D(in_c, squeeze, 1), nn.ReLU())
+        self.e1 = nn.Sequential(nn.Conv2D(squeeze, e1, 1), nn.ReLU())
+        self.e3 = nn.Sequential(nn.Conv2D(squeeze, e3, 3, padding=1), nn.ReLU())
+
+    def forward(self, x):
+        s = self.squeeze(x)
+        return concat([self.e1(s), self.e3(s)], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), nn.MaxPool2D(3, stride=2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, stride=2), _Fire(512, 64, 256, 256))
+        else:
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        self.classifier = nn.Sequential(
+            nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
+            nn.AdaptiveAvgPool2D((1, 1)))
+
+    def forward(self, x):
+        x = self.classifier(self.features(x))
+        return x.flatten(1)
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    return SqueezeNet("1.1", **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# MobileNetV1
+# ---------------------------------------------------------------------------
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + \
+              [(512, 1024, 2), (1024, 1024, 1)]
+        c0 = int(32 * scale)
+        layers = [_conv_bn(3, c0, 3, stride=2, padding=1)]
+        for in_c, out_c, s in cfg:
+            ic, oc = int(in_c * scale), int(out_c * scale)
+            layers += [_conv_bn(ic, ic, 3, stride=s, padding=1, groups=ic),
+                       _conv_bn(ic, oc, 1)]
+        self.features = nn.Sequential(*layers)
+        self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.fc = nn.Linear(int(1024 * scale), num_classes)
+
+    def forward(self, x):
+        x = self.pool(self.features(x)).flatten(1)
+        return self.fc(x)
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# MobileNetV3
+# ---------------------------------------------------------------------------
+
+class _SE(nn.Layer):
+    def __init__(self, c, r=4):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.fc1 = nn.Conv2D(c, c // r, 1)
+        self.fc2 = nn.Conv2D(c // r, c, 1)
+        self.relu = nn.ReLU()
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _MBV3Block(nn.Layer):
+    def __init__(self, in_c, exp, out_c, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if exp != in_c:
+            layers.append(_conv_bn(in_c, exp, 1, act=act))
+        layers.append(_conv_bn(exp, exp, k, stride=stride, padding=k // 2,
+                               groups=exp, act=act))
+        if use_se:
+            layers.append(_SE(exp))
+        layers.append(_conv_bn(exp, out_c, 1, act="none"))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+_V3_LARGE = [
+    (16, 16, 16, 3, 1, False, "relu"), (16, 64, 24, 3, 2, False, "relu"),
+    (24, 72, 24, 3, 1, False, "relu"), (24, 72, 40, 5, 2, True, "relu"),
+    (40, 120, 40, 5, 1, True, "relu"), (40, 120, 40, 5, 1, True, "relu"),
+    (40, 240, 80, 3, 2, False, "hardswish"),
+    (80, 200, 80, 3, 1, False, "hardswish"),
+    (80, 184, 80, 3, 1, False, "hardswish"),
+    (80, 184, 80, 3, 1, False, "hardswish"),
+    (80, 480, 112, 3, 1, True, "hardswish"),
+    (112, 672, 112, 3, 1, True, "hardswish"),
+    (112, 672, 160, 5, 2, True, "hardswish"),
+    (160, 960, 160, 5, 1, True, "hardswish"),
+    (160, 960, 160, 5, 1, True, "hardswish")]
+
+_V3_SMALL = [
+    (16, 16, 16, 3, 2, True, "relu"), (16, 72, 24, 3, 2, False, "relu"),
+    (24, 88, 24, 3, 1, False, "relu"), (24, 96, 40, 5, 2, True, "hardswish"),
+    (40, 240, 40, 5, 1, True, "hardswish"),
+    (40, 240, 40, 5, 1, True, "hardswish"),
+    (40, 120, 48, 5, 1, True, "hardswish"),
+    (48, 144, 48, 5, 1, True, "hardswish"),
+    (48, 288, 96, 5, 2, True, "hardswish"),
+    (96, 576, 96, 5, 1, True, "hardswish"),
+    (96, 576, 96, 5, 1, True, "hardswish")]
+
+
+def _make_divisible(v, divisor=8):
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_c, num_classes=1000, scale=1.0,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        sc = lambda c: _make_divisible(c * scale)  # noqa: E731
+        layers = [_conv_bn(3, sc(16), 3, stride=2, padding=1, act="hardswish")]
+        for in_c, exp, out_c, k, s, se, act in cfg:
+            layers.append(_MBV3Block(sc(in_c), sc(exp), sc(out_c), k, s, se,
+                                     act))
+        last_exp = sc(cfg[-1][1])
+        layers.append(_conv_bn(sc(cfg[-1][2]), last_exp, 1, act="hardswish"))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(last_exp, last_c), nn.Hardswish(), nn.Dropout(0.2),
+                nn.Linear(last_c, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+class MobileNetV3Large(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_LARGE, 1280, num_classes, scale, with_pool)
+
+
+class MobileNetV3Small(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_SMALL, 1024, num_classes, scale, with_pool)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Large(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# ShuffleNetV2
+# ---------------------------------------------------------------------------
+
+def _channel_shuffle(x, groups):
+    n, c, h, w = x.shape
+    x = reshape(x, [n, groups, c // groups, h, w])
+    x = transpose(x, [0, 2, 1, 3, 4])
+    return reshape(x, [n, c, h, w])
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, in_c, out_c, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride == 2:
+            self.branch1 = nn.Sequential(
+                _conv_bn(in_c, in_c, 3, stride=2, padding=1, groups=in_c,
+                         act="none"),
+                _conv_bn(in_c, branch_c, 1, act=act))
+            in_b2 = in_c
+        else:
+            self.branch1 = None
+            in_b2 = in_c // 2
+        self.branch2 = nn.Sequential(
+            _conv_bn(in_b2, branch_c, 1, act=act),
+            _conv_bn(branch_c, branch_c, 3, stride=stride, padding=1,
+                     groups=branch_c, act="none"),
+            _conv_bn(branch_c, branch_c, 1, act=act))
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+_SHUFFLE_CFG = {0.25: (24, 48, 96, 512), 0.33: (32, 64, 128, 512),
+                0.5: (48, 96, 192, 1024), 1.0: (116, 232, 464, 1024),
+                1.5: (176, 352, 704, 1024), 2.0: (244, 488, 976, 2048)}
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        c1, c2, c3, c4 = _SHUFFLE_CFG[scale]
+        self.conv1 = _conv_bn(3, 24, 3, stride=2, padding=1, act=act)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        in_c = 24
+        for out_c, repeat in ((c1, 4), (c2, 8), (c3, 4)):
+            units = [_ShuffleUnit(in_c, out_c, 2, act)]
+            for _ in range(repeat - 1):
+                units.append(_ShuffleUnit(out_c, out_c, 1, act))
+            stages.append(nn.Sequential(*units))
+            in_c = out_c
+        self.stages = nn.Sequential(*stages)
+        self.conv5 = _conv_bn(c3, c4, 1, act=act)
+        self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.fc = nn.Linear(c4, num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        x = self.conv5(self.stages(x))
+        return self.fc(self.pool(x).flatten(1))
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return ShuffleNetV2(0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return ShuffleNetV2(0.33, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(0.5, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(1.0, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(2.0, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return ShuffleNetV2(1.0, act="swish", **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# GoogLeNet / InceptionV3
+# ---------------------------------------------------------------------------
+
+class _Inception(nn.Layer):
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, pj):
+        super().__init__()
+        self.b1 = _conv_bn(in_c, c1, 1)
+        self.b2 = nn.Sequential(_conv_bn(in_c, c3r, 1),
+                                _conv_bn(c3r, c3, 3, padding=1))
+        self.b3 = nn.Sequential(_conv_bn(in_c, c5r, 1),
+                                _conv_bn(c5r, c5, 5, padding=2))
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                _conv_bn(in_c, pj, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            _conv_bn(3, 64, 7, stride=2, padding=3), nn.MaxPool2D(3, 2, padding=1),
+            _conv_bn(64, 64, 1), _conv_bn(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, 2, padding=1))
+        self.i3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, 2, padding=1)
+        self.i4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, 2, padding=1)
+        self.i5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.dropout = nn.Dropout(0.2)
+        self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.pool4(self.i4e(self.i4d(self.i4c(self.i4b(self.i4a(x))))))
+        x = self.i5b(self.i5a(x))
+        x = self.dropout(self.pool(x).flatten(1))
+        out = self.fc(x)
+        # reference returns (out, aux1, aux2); aux heads inactive at eval
+        return out, out, out
+
+
+def googlenet(pretrained=False, **kwargs):
+    return GoogLeNet(**kwargs)
+
+
+class InceptionV3(nn.Layer):
+    """Simplified InceptionV3 trunk (stem + inception stacks + head) — the
+    reference topology with the factorized 7x7 branches folded to 3x3 pairs."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            _conv_bn(3, 32, 3, stride=2), _conv_bn(32, 32, 3),
+            _conv_bn(32, 64, 3, padding=1), nn.MaxPool2D(3, 2),
+            _conv_bn(64, 80, 1), _conv_bn(80, 192, 3), nn.MaxPool2D(3, 2))
+        self.blocks = nn.Sequential(
+            _Inception(192, 64, 48, 64, 64, 96, 32),
+            _Inception(256, 64, 48, 64, 64, 96, 64),
+            _Inception(288, 64, 48, 64, 64, 96, 64),
+            nn.MaxPool2D(3, 2),
+            _Inception(288, 192, 128, 192, 128, 192, 192),
+            _Inception(768, 192, 160, 192, 160, 192, 192),
+            nn.MaxPool2D(3, 2),
+            _Inception(768, 320, 192, 384, 192, 384, 192))
+        self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.dropout = nn.Dropout(0.5)
+        self.fc = nn.Linear(1280, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        return self.fc(self.dropout(self.pool(x).flatten(1)))
+
+
+def inception_v3(pretrained=False, **kwargs):
+    return InceptionV3(**kwargs)
+
+
+# ---------------------------------------------------------------------------
+# DenseNet
+# ---------------------------------------------------------------------------
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_c, growth, bn_size):
+        super().__init__()
+        self.fn = nn.Sequential(
+            nn.BatchNorm2D(in_c), nn.ReLU(),
+            nn.Conv2D(in_c, bn_size * growth, 1, bias_attr=False),
+            nn.BatchNorm2D(bn_size * growth), nn.ReLU(),
+            nn.Conv2D(bn_size * growth, growth, 3, padding=1, bias_attr=False))
+
+    def forward(self, x):
+        return concat([x, self.fn(x)], axis=1)
+
+
+_DENSE_CFG = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
+              169: (6, 12, 32, 32), 201: (6, 12, 48, 32),
+              264: (6, 12, 64, 48)}
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, growth_rate=32, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        if layers == 161:
+            growth_rate, init_c = 48, 96
+        else:
+            init_c = 64
+        cfg = _DENSE_CFG[layers]
+        feats = [_conv_bn(3, init_c, 7, stride=2, padding=3),
+                 nn.MaxPool2D(3, 2, padding=1)]
+        c = init_c
+        for i, n in enumerate(cfg):
+            for _ in range(n):
+                feats.append(_DenseLayer(c, growth_rate, bn_size))
+                c += growth_rate
+            if i != len(cfg) - 1:
+                feats += [nn.BatchNorm2D(c), nn.ReLU(),
+                          nn.Conv2D(c, c // 2, 1, bias_attr=False),
+                          nn.AvgPool2D(2, 2)]
+                c //= 2
+        feats += [nn.BatchNorm2D(c), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.dropout_p = dropout
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.dropout = nn.Dropout(dropout) if dropout > 0 else None
+            self.fc = nn.Linear(c, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            if self.dropout is not None:
+                x = self.dropout(x)
+            x = self.fc(x)
+        return x
+
+
+def densenet121(pretrained=False, **kwargs):
+    return DenseNet(121, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return DenseNet(161, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return DenseNet(169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return DenseNet(201, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return DenseNet(264, **kwargs)

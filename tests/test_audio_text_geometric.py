@@ -1,0 +1,121 @@
+"""paddle.audio / paddle.text / paddle.geometric + new vision families
+(ref python/paddle/{audio,text,geometric}/, vision/models/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_mel_scale_roundtrip():
+    AF = paddle.audio.functional
+    for htk in (False, True):
+        hz = AF.mel_to_hz(AF.hz_to_mel(440.0, htk), htk)
+        np.testing.assert_allclose(hz, 440.0, rtol=1e-5)
+        freqs = np.array([100.0, 1000.0, 4000.0], np.float32)
+        back = AF.mel_to_hz(AF.hz_to_mel(paddle.to_tensor(freqs), htk), htk)
+        np.testing.assert_allclose(back.numpy(), freqs, rtol=1e-4)
+
+
+def test_fbank_matrix_properties():
+    AF = paddle.audio.functional
+    fb = AF.compute_fbank_matrix(16000, 512, n_mels=40).numpy()
+    assert fb.shape == (40, 257)
+    assert (fb >= 0).all() and fb.sum() > 0
+
+
+def test_spectrogram_matches_manual():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 2048).astype(np.float32)
+    spec = paddle.audio.features.Spectrogram(n_fft=256, hop_length=128)(
+        paddle.to_tensor(x)).numpy()
+    assert spec.shape[1] == 129  # n_fft//2 + 1
+    assert (spec >= 0).all()
+    # Parseval-flavored sanity: energy concentrated where signal is
+    x2 = np.zeros((1, 2048), np.float32)
+    spec0 = paddle.audio.features.Spectrogram(n_fft=256)(
+        paddle.to_tensor(x2)).numpy()
+    assert spec0.max() < 1e-10
+
+
+def test_mfcc_pipeline_shapes():
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 4096).astype(np.float32)
+    mfcc = paddle.audio.features.MFCC(sr=16000, n_mfcc=13, n_fft=512,
+                                      n_mels=40)(paddle.to_tensor(x))
+    assert mfcc.shape[0] == 3 and mfcc.shape[1] == 13
+    assert np.isfinite(mfcc.numpy()).all()
+
+
+def test_viterbi_decode_against_bruteforce():
+    rng = np.random.RandomState(0)
+    B, T, N = 2, 5, 3
+    emis = rng.randn(B, T, N).astype(np.float32)
+    trans = rng.randn(N, N).astype(np.float32)
+    lens = np.array([5, 3], np.int64)
+    scores, paths = paddle.text.viterbi_decode(
+        paddle.to_tensor(emis), paddle.to_tensor(trans),
+        paddle.to_tensor(lens), include_bos_eos_tag=False)
+    import itertools
+    for b in range(B):
+        L = lens[b]
+        best, best_path = -1e30, None
+        for p in itertools.product(range(N), repeat=int(L)):
+            s = emis[b, 0, p[0]]
+            for t in range(1, L):
+                s += trans[p[t - 1], p[t]] + emis[b, t, p[t]]
+            if s > best:
+                best, best_path = s, p
+        np.testing.assert_allclose(float(scores.numpy()[b]), best, rtol=1e-5)
+        np.testing.assert_array_equal(paths.numpy()[b][:L], best_path)
+
+
+def test_text_datasets_raise_clearly():
+    with pytest.raises(RuntimeError, match="no network egress"):
+        paddle.text.Imdb()
+
+
+def test_geometric_message_passing():
+    G = paddle.geometric
+    x = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.]], np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 2], np.int32))
+    dst = paddle.to_tensor(np.array([1, 1, 0], np.int32))
+    out = G.send_u_recv(x, src, dst, "sum")
+    np.testing.assert_allclose(out.numpy(), [[5, 6], [4, 6], [0, 0]])
+    e = paddle.to_tensor(np.full((3, 2), 10.0, np.float32))
+    out2 = G.send_ue_recv(x, e, src, dst, "add", "max")
+    np.testing.assert_allclose(out2.numpy(), [[15, 16], [13, 14], [0, 0]])
+    msgs = G.send_uv(x, x, src, dst, "mul")
+    np.testing.assert_allclose(msgs.numpy(), [[3, 8], [9, 16], [5, 12]])
+
+
+def test_geometric_sampling_and_reindex():
+    G = paddle.geometric
+    # CSC: node 0 <- {1, 2}, node 1 <- {2}, node 2 <- {}
+    row = paddle.to_tensor(np.array([1, 2, 2], np.int64))
+    colptr = paddle.to_tensor(np.array([0, 2, 3, 3], np.int64))
+    nodes = paddle.to_tensor(np.array([0, 1], np.int64))
+    neigh, cnt = G.sample_neighbors(row, colptr, nodes, sample_size=-1)
+    np.testing.assert_array_equal(cnt.numpy(), [2, 1])
+    np.testing.assert_array_equal(neigh.numpy(), [1, 2, 2])
+    s, d, out_nodes = G.reindex_graph(nodes, neigh, cnt)
+    np.testing.assert_array_equal(out_nodes.numpy(), [0, 1, 2])
+    np.testing.assert_array_equal(s.numpy(), [1, 2, 2])
+    np.testing.assert_array_equal(d.numpy(), [0, 0, 1])
+
+
+def test_vision_families_complete():
+    from paddle_tpu.vision import models as M
+    fams = ["ResNet", "VGG", "LeNet", "AlexNet", "MobileNetV1", "MobileNetV2",
+            "MobileNetV3Large", "MobileNetV3Small", "SqueezeNet", "DenseNet",
+            "GoogLeNet", "InceptionV3", "ShuffleNetV2"]
+    for f in fams:
+        assert hasattr(M, f), f
+    # constructors + forward on tiny inputs for the new compact families
+    x = paddle.to_tensor(np.random.RandomState(0).randn(1, 3, 64, 64)
+                         .astype(np.float32))
+    for make in (lambda: M.squeezenet1_1(num_classes=7),
+                 lambda: M.mobilenet_v3_small(num_classes=7),
+                 lambda: M.shufflenet_v2_x0_5(num_classes=7)):
+        m = make()
+        m.eval()
+        assert list(m(x).shape) == [1, 7]
