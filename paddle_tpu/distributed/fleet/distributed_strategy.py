@@ -82,6 +82,7 @@ class DistributedStrategy:
             mp=mp,
             ep=int(h.get("ep_degree", 1)),
             cp=int(h.get("sep_degree", 1)),   # sequence axis -> ring CP
+            vpp=int(h.get("pp_configs", {}).get("virtual_pp_degree", 1) or 1),
             sharding_stage=sharding_stage,
             micro_batches=max(micro, 1),
             sequence_parallel=bool(h.get("mp_configs", {})

@@ -41,6 +41,7 @@ class MeshConfig:
     mp: int = 1
     ep: int = 1                  # expert-parallel degree (MoE all-to-all group)
     cp: int = 1                  # context-parallel degree (ring attention)
+    vpp: int = 1                 # virtual pipeline chunks per stage (interleave)
     sharding_stage: int = 1      # ZeRO stage: 1=opt state, 2=+grads, 3=+params
     micro_batches: int = 1       # pipeline microbatches (per global step)
     sequence_parallel: bool = False
@@ -361,7 +362,15 @@ def _pp_loss(params, tokens, labels, config, cfg: MeshConfig, mesh):
     only the boundary activation stack (per-block internals rematerialize via
     run_blocks' checkpoint policy) — the 1F1B memory profile without the
     hand-written send/recv schedule.  The LM head runs once per token, sharded
-    over pp (microbatches) and mp (vocab) — no per-tick head waste."""
+    over pp (microbatches) and mp (vocab) — no per-tick head waste.
+
+    Interleaving (cfg.vpp > 1, ref PipelineParallelWithInterleave :822): each
+    stage holds vpp NON-CONTIGUOUS layer chunks (chunk c covers layers
+    [c*P*Lc + p*Lc, ...]); every tick runs ONE chunk, 1/vpp of a GPipe tick, and
+    the Megatron closed-form schedule (device p delayed p ticks, work order
+    g-major then chunk then slot) makes every ring hand-off arrive exactly one
+    tick ahead of use.  Warmup/cooldown ticks shrink from (P-1) full-stage
+    ticks to (P-1) chunk ticks — the pipeline bubble drops by vpp."""
     M = cfg.micro_batches
     Ppp = cfg.pp
     B, S = tokens.shape
@@ -383,39 +392,86 @@ def _pp_loss(params, tokens, labels, config, cfg: MeshConfig, mesh):
         x = x + params["wpe"][:S]
     xs = x.reshape(M, mb, S, D)
 
+    vpp = cfg.vpp
+    if vpp > 1:
+        assert M % Ppp == 0, \
+            f"interleaved schedule needs micro_batches {M} % pp {Ppp} == 0"
+        assert config.num_layers % (Ppp * vpp) == 0, \
+            f"layers {config.num_layers} must divide over pp*vpp"
+        # chunk c of stage p = layers [(c*Ppp + p) * Lc, ...): reshape the
+        # stacked layer axis to [vpp, Ppp, Lc] and shard the Ppp axis
+        blocks_arg = jax.tree_util.tree_map(
+            lambda a: a.reshape((vpp, Ppp, a.shape[0] // (vpp * Ppp))
+                                + a.shape[1:]), params["blocks"])
+        T = vpp * M + Ppp - 1
+    else:
+        blocks_arg = params["blocks"]
+        T = M + Ppp - 1
+
     def local_fn(blocks_local, xs_rep):
         p = jax.lax.axis_index("pp")
-        T = M + Ppp - 1
 
         def tick(carry, t):
             buf, aux_acc = carry
-            inp = jnp.where(p == 0, xs_rep[jnp.clip(t, 0, M - 1)], buf)
-            out, aux = gpt_mod.run_blocks(blocks_local, inp, config,
+            if vpp > 1:
+                u = t - p                  # this device's schedule position
+                uc = jnp.clip(u, 0, vpp * M - 1)
+                g = uc // (vpp * Ppp)      # microbatch group
+                r = uc % (vpp * Ppp)
+                c = r // Ppp               # virtual chunk
+                m = g * Ppp + (r % Ppp)    # microbatch index
+                chunk = jax.tree_util.tree_map(lambda a: a[c][0], blocks_local)
+                inject = (p == 0) & (c == 0)
+                valid = ((u >= 0) & (u < vpp * M))
+            else:
+                chunk = blocks_local
+                m = jnp.clip(t, 0, M - 1)
+                inject = p == 0
+                valid = (t >= p) & (t < p + M)
+            inp = jnp.where(inject, xs_rep[m], buf)
+            out, aux = gpt_mod.run_blocks(chunk, inp, config,
                                           remat=cfg.remat, moe_impl=moe_impl)
             nxt = jax.lax.ppermute(out, "pp",
                                    [(i, (i + 1) % Ppp) for i in range(Ppp)])
-            # stage p holds real microbatch (t - p) only for p <= t < p + M;
-            # warmup/cooldown ticks run on garbage and must not pollute aux
-            valid = ((t >= p) & (t < p + M)).astype(aux.dtype)
-            return (nxt, aux_acc + aux * valid), out
+            # invalid (warmup/cooldown) ticks run on garbage; mask their aux
+            return (nxt, aux_acc + aux * valid.astype(aux.dtype)), out
 
         buf0 = gpt_mod.pvary_compat(jnp.zeros((mb_l, S, D), xs_rep.dtype), manual)
         aux0 = gpt_mod.pvary_compat(jnp.zeros((), jnp.float32), manual)
         (_, aux_sum), outs = jax.lax.scan(tick, (buf0, aux0), jnp.arange(T))
-        # ticks Ppp-1 .. T-1 hold finished microbatches 0..M-1 on the LAST stage
-        return outs[Ppp - 1:], jax.lax.psum(aux_sum, manual)
+        if vpp == 1:
+            # drop warmup garbage IN-shard: only M ticks cross the boundary
+            outs = outs[Ppp - 1:]
+        return outs, jax.lax.psum(aux_sum, manual)
 
-    blk_in = {k: (P("pp", "ep") if (moe_manual and k in _MOE_EXPERT_KEYS)
-                  else P("pp"))
-              for k in params["blocks"]}
+    if vpp > 1:
+        # vpp reshape puts experts' E on dim 3: [vpp, Ppp, Lc, E, ...]
+        blk_in = {k: (P(None, "pp", None, "ep") if (moe_manual and
+                                                    k in _MOE_EXPERT_KEYS)
+                      else P(None, "pp"))
+                  for k in params["blocks"]}
+    else:
+        blk_in = {k: (P("pp", "ep") if (moe_manual and k in _MOE_EXPERT_KEYS)
+                      else P("pp"))
+                  for k in params["blocks"]}
     f = jax.shard_map(
         local_fn, mesh=mesh, axis_names=set(manual),
         in_specs=(blk_in, P(None, "ep") if moe_manual else P()),
         out_specs=(P("pp", "ep") if moe_manual else P("pp"), P()))
-    stacked, aux_sum = f(params["blocks"], xs)  # [Ppp*M, mb, S, D]
+    stacked_all, aux_sum = f(blocks_arg, xs)   # [Ppp*T, mb, S, D]
     if moe_manual:
         aux_sum = aux_sum / cfg.ep
-    hs = stacked[(Ppp - 1) * M:]               # last stage's [M, mb, S, D]
+    if vpp > 1:
+        # microbatch m finishes its LAST chunk on stage Ppp-1 at tick
+        # (m//P)*vpp*P + (vpp-1)*P + (m%P) + (P-1)
+        idx = [(m // Ppp) * vpp * Ppp + (vpp - 1) * Ppp + (m % Ppp) + Ppp - 1
+               for m in range(M)]
+        stacked = stacked_all[np.asarray([(Ppp - 1) * T + t for t in idx])]
+    else:
+        # each stage contributed M post-warmup ticks; the last stage's hold
+        # finished microbatches 0..M-1
+        stacked = stacked_all[(Ppp - 1) * M:]
+    hs = stacked                               # last stage's [M, mb, S, D]
     h = gpt_mod._norm(hs.reshape(B, S, D), params["lnf_w"], params["lnf_b"],
                       config)
     head = params["wte"].T if config.tie_word_embeddings else params["lm_head"]

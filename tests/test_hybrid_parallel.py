@@ -208,3 +208,37 @@ def test_pp4_parity():
         l0 = float(single.train_step(tok, lab))
         l1 = float(pp4.train_step(tok, lab))
         np.testing.assert_allclose(l1, l0, rtol=2e-4)
+
+
+def test_interleaved_virtual_pipeline_matches_single():
+    """vpp>1 (ref PipelineParallelWithInterleave :822): non-contiguous layer
+    chunks per stage, Megatron closed-form schedule; parity vs single chip."""
+    import jax
+    from paddle_tpu.models.gpt import GPTConfig
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=8, num_heads=4,
+                    max_seq_len=64)
+    rng = np.random.RandomState(0)
+    tok = rng.randint(0, cfg.vocab_size, (8, 64)).astype(np.int32)
+    lab = np.roll(tok, -1, 1).astype(np.int32)
+    ref = _losses(HybridParallelTrainer(cfg, MeshConfig(), seed=3,
+                                        devices=jax.devices()[:1]), tok, lab)
+    for mc, n in ((MeshConfig(pp=2, vpp=2, micro_batches=4), 2),
+                  (MeshConfig(pp=4, vpp=2, micro_batches=4, remat=True), 4),
+                  (MeshConfig(dp=2, pp=2, vpp=2, mp=2, micro_batches=2), 8)):
+        got = _losses(HybridParallelTrainer(cfg, mc, seed=3,
+                                            devices=jax.devices()[:n]),
+                      tok, lab)
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+def test_interleave_divisibility_asserts():
+    import jax
+    import pytest as _pytest
+    from paddle_tpu.models.gpt import GPTConfig
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=6, num_heads=4,
+                    max_seq_len=64)
+    tr = HybridParallelTrainer(cfg, MeshConfig(pp=2, vpp=2, micro_batches=2),
+                               seed=0, devices=jax.devices()[:2])
+    tok = np.zeros((4, 64), np.int32)
+    with _pytest.raises(AssertionError, match="divide over pp\\*vpp"):
+        tr.train_step(tok, tok)

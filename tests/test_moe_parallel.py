@@ -161,3 +161,19 @@ def test_dispatch_matches_reference_dense_formulation():
     out_new = combine(eo, slot, keep, gate_val)
     np.testing.assert_allclose(np.asarray(out_new), np.asarray(out_ref),
                                atol=1e-5)
+
+
+def test_moe_interleaved_pp_ep_matches_dense():
+    """vpp x pp x ep: expert axis lands on dim 3 after the vpp chunk reshape."""
+    from paddle_tpu.models.gpt import GPTConfig
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=4, num_heads=4,
+                    max_seq_len=64, moe_num_experts=4, moe_capacity_factor=8.0,
+                    moe_aux_weight=0.0)
+    tok, lab = _data(cfg)
+    ref = _losses(HybridParallelTrainer(cfg, MeshConfig(), seed=3,
+                                        devices=jax.devices()[:1]), tok, lab)
+    got = _losses(
+        HybridParallelTrainer(cfg, MeshConfig(pp=2, ep=2, vpp=2,
+                                              micro_batches=2),
+                              seed=3, devices=jax.devices()[:4]), tok, lab)
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
