@@ -25,3 +25,5 @@ def graph_sample_neighbors(*args, **kwargs):
 
 def graph_reindex(*args, **kwargs):
     raise NotImplementedError("see paddle_tpu.geometric sampling note")
+
+from .custom_op import custom_op_from_c, get_custom_op, register_custom_op  # noqa

@@ -1,0 +1,95 @@
+"""Custom operator registration.
+
+Reference parity: `phi/api/ext/op_meta_info.h:943` (PD_BUILD_OP user ops),
+`fluid/framework/custom_operator.cc`, and the custom-kernel C ABI
+(`phi/capi/`).  Two TPU-native registration paths:
+
+- `register_custom_op(name, forward, backward=None)`: forward/backward are
+  jnp functions — the op dispatches through the eager tape (`apply`), works
+  under `to_static` capture and jit, and a provided backward becomes a
+  `jax.custom_vjp` rule (the generated GradNode of the reference).
+- `custom_op_from_c(lib, symbol, ...)`: wraps a C-ABI kernel built with
+  `paddle.utils.cpp_extension.load` via `jax.pure_callback`, so host-native
+  kernels participate in jitted programs (the custom CPU-kernel plugin path;
+  device kernels belong in Pallas).
+"""
+from __future__ import annotations
+
+import ctypes
+import functools
+from typing import Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_custom_op(name: str, forward: Callable,
+                       backward: Optional[Callable] = None):
+    """Register op `name`.  forward(*jnp_arrays) -> jnp array (or tuple);
+    backward(saved_inputs, grad_out) -> tuple of input grads (or None per
+    non-differentiable input).  Returns the Tensor-callable op."""
+    if backward is not None:
+        @jax.custom_vjp
+        def core(*datas):
+            return forward(*datas)
+
+        def fwd(*datas):
+            return forward(*datas), datas
+
+        def bwd(saved, g):
+            grads = backward(saved, g)
+            grads = grads if isinstance(grads, (tuple, list)) else (grads,)
+            out = []
+            for d, gr in zip(saved, grads):
+                out.append(jnp.zeros_like(d) if gr is None else gr)
+            return tuple(out)
+
+        core.defvjp(fwd, bwd)
+        impl = core
+    else:
+        impl = forward
+
+    def op(*tensors, **kwargs):
+        fn = functools.partial(impl, **kwargs) if kwargs else impl
+        return apply(name, fn, *tensors)
+
+    op.__name__ = name
+    _REGISTRY[name] = op
+    return op
+
+
+def get_custom_op(name: str) -> Callable:
+    return _REGISTRY[name]
+
+
+def custom_op_from_c(lib, symbol: str, out_dtype=None,
+                     out_shape_fn: Optional[Callable] = None,
+                     name: Optional[str] = None):
+    """Wrap a C kernel `void f(const T* in, T* out, int64 n)` (elementwise
+    contract, the fake-device test-kernel shape) as a jit-capable op."""
+    cfun = getattr(lib, symbol)
+    cfun.restype = None
+    cfun.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+
+    def host_call(x):
+        x = np.ascontiguousarray(x)
+        out = np.empty_like(x)
+        cfun(x.ctypes.data_as(ctypes.c_void_p),
+             out.ctypes.data_as(ctypes.c_void_p), x.size)
+        return out
+
+    def forward(x):
+        shape = out_shape_fn(x.shape) if out_shape_fn else x.shape
+        dt = out_dtype or x.dtype
+        return jax.pure_callback(
+            host_call, jax.ShapeDtypeStruct(shape, dt), x, vmap_method="sequential")
+
+    return register_custom_op(name or symbol, forward)
+
+
+__all__ = ["register_custom_op", "get_custom_op", "custom_op_from_c"]

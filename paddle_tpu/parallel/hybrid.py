@@ -439,9 +439,16 @@ def _pp_loss(params, tokens, labels, config, cfg: MeshConfig, mesh):
         buf0 = gpt_mod.pvary_compat(jnp.zeros((mb_l, S, D), xs_rep.dtype), manual)
         aux0 = gpt_mod.pvary_compat(jnp.zeros((), jnp.float32), manual)
         (_, aux_sum), outs = jax.lax.scan(tick, (buf0, aux0), jnp.arange(T))
+        # drop warmup/cooldown garbage IN-shard: only M ticks (and their grad
+        # cotangents) cross the shard_map boundary.  The finish ticks are
+        # static; only the LAST stage's slice is consumed downstream, but every
+        # stage must slice identically for a uniform out_spec.
         if vpp == 1:
-            # drop warmup garbage IN-shard: only M ticks cross the boundary
             outs = outs[Ppp - 1:]
+        else:
+            finish = [(m // Ppp) * vpp * Ppp + (vpp - 1) * Ppp + (m % Ppp)
+                      + Ppp - 1 for m in range(M)]
+            outs = outs[np.asarray(finish)]
         return outs, jax.lax.psum(aux_sum, manual)
 
     if vpp > 1:
@@ -458,20 +465,12 @@ def _pp_loss(params, tokens, labels, config, cfg: MeshConfig, mesh):
         local_fn, mesh=mesh, axis_names=set(manual),
         in_specs=(blk_in, P(None, "ep") if moe_manual else P()),
         out_specs=(P("pp", "ep") if moe_manual else P("pp"), P()))
-    stacked_all, aux_sum = f(blocks_arg, xs)   # [Ppp*T, mb, S, D]
+    stacked_all, aux_sum = f(blocks_arg, xs)   # [Ppp*M, mb, S, D]
     if moe_manual:
         aux_sum = aux_sum / cfg.ep
-    if vpp > 1:
-        # microbatch m finishes its LAST chunk on stage Ppp-1 at tick
-        # (m//P)*vpp*P + (vpp-1)*P + (m%P) + (P-1)
-        idx = [(m // Ppp) * vpp * Ppp + (vpp - 1) * Ppp + (m % Ppp) + Ppp - 1
-               for m in range(M)]
-        stacked = stacked_all[np.asarray([(Ppp - 1) * T + t for t in idx])]
-    else:
-        # each stage contributed M post-warmup ticks; the last stage's hold
-        # finished microbatches 0..M-1
-        stacked = stacked_all[(Ppp - 1) * M:]
-    hs = stacked                               # last stage's [M, mb, S, D]
+    # each stage contributed M sliced ticks; the last stage's hold finished
+    # microbatches 0..M-1 in order
+    hs = stacked_all[(Ppp - 1) * M:]           # [M, mb, S, D]
     h = gpt_mod._norm(hs.reshape(B, S, D), params["lnf_w"], params["lnf_b"],
                       config)
     head = params["wte"].T if config.tie_word_embeddings else params["lm_head"]
@@ -567,6 +566,10 @@ class HybridParallelTrainer:
             assert cfg.pp == 1 and cfg.ep == 1, \
                 "cp composes with dp/sharding/mp; cp x pp / cp x ep are not " \
                 "supported yet"
+        if cfg.vpp > 1:
+            assert cfg.pp > 1, \
+                "vpp (interleaved virtual stages) requires pp > 1 (ref: " \
+                "virtual_pp_degree needs pipeline parallelism)"
 
         def loss_of(params, tokens, labels):
             if cfg.pp > 1:

@@ -602,5 +602,7 @@ __all__ = [
     "normalize_program", "program_guard", "py_func", "save",
     "save_inference_model", "save_to_file", "scope_guard",
     "serialize_persistables", "serialize_program", "set_ipu_shard",
-    "set_program_state", "xpu_places",
+    "set_program_state", "xpu_places", "nn",
 ]
+
+from . import nn  # noqa  (static.nn control flow + layer helpers)

@@ -1,0 +1,162 @@
+"""paddle.static.nn — static-graph control flow + layer helpers.
+
+Reference parity: `python/paddle/static/nn/` (cond/case/switch_case/while_loop
+build ConditionalBlock/While ops; fc/embedding/batch_norm build layers
+inline).
+
+TPU-native: under eager execution with concrete values, control flow is plain
+Python (the reference's dygraph convert_* behavior).  Under `to_static`
+capture the predicates are tracers: `cond`/`case`/`switch_case` evaluate both
+branches and select (functional branches — XLA DCEs the untaken side when the
+predicate folds), and `while_loop` lowers to `jax.lax.while_loop`, giving REAL
+data-dependent trip counts inside the compiled program (forward/inference;
+reverse-mode through a dynamic while is unsupported, as jax defines).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply, _to_data
+
+
+def _is_traced(x) -> bool:
+    d = x._data if isinstance(x, Tensor) else x
+    return isinstance(d, jax.core.Tracer)
+
+
+def _tree_select(pred, t_out, f_out):
+    flat_t, tdef = jax.tree_util.tree_flatten(
+        t_out, is_leaf=lambda x: isinstance(x, Tensor))
+    flat_f, _ = jax.tree_util.tree_flatten(
+        f_out, is_leaf=lambda x: isinstance(x, Tensor))
+    outs = []
+    for a, b in zip(flat_t, flat_f):
+        outs.append(apply("cond_select",
+                          lambda p, x, y: jnp.where(p, x, y), pred, a, b))
+    return jax.tree_util.tree_unflatten(tdef, outs)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """ref static.nn.cond: data-dependent branch."""
+    if not _is_traced(pred):
+        taken = bool(_to_data(pred))
+        if taken:
+            return true_fn() if true_fn is not None else None
+        return false_fn() if false_fn is not None else None
+    t_out = true_fn() if true_fn is not None else None
+    f_out = false_fn() if false_fn is not None else None
+    if t_out is None and f_out is None:
+        return None
+    return _tree_select(pred, t_out, f_out)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """ref static.nn.case: first true predicate wins."""
+    if not pred_fn_pairs:
+        return default() if default else None
+    (pred, fn), *rest = pred_fn_pairs
+    return cond(pred, fn,
+                (lambda: case(rest, default)) if (rest or default) else None)
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """ref static.nn.switch_case: integer-indexed dispatch."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns))
+    if not _is_traced(branch_index):
+        idx = int(_to_data(branch_index))
+        for k, fn in items:
+            if k == idx:
+                return fn()
+        return default() if default else None
+    pairs = [(apply("eq", lambda b: b == k, branch_index), fn)
+             for k, fn in items]
+    return case(pairs, default)
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
+               is_test: bool = False, name=None) -> List:
+    """ref static.nn.while_loop: data-dependent loop.
+
+    Eager (concrete values): a Python loop, exactly the reference's dygraph
+    convert_while_loop.  Under capture: `jax.lax.while_loop` — the trip count
+    stays data-dependent inside the compiled program."""
+    vars_t = [v if isinstance(v, Tensor) else Tensor(_to_data(v))
+              for v in loop_vars]
+    traced = any(_is_traced(v) for v in vars_t) or \
+        _is_traced(cond_fn(*vars_t))
+    if not traced:
+        while bool(_to_data(cond_fn(*vars_t))):
+            out = body_fn(*vars_t)
+            out = out if isinstance(out, (list, tuple)) else [out]
+            vars_t = [v if isinstance(v, Tensor) else Tensor(_to_data(v))
+                      for v in out]
+        return list(vars_t)
+
+    def c(datas):
+        r = cond_fn(*[Tensor(d) for d in datas])
+        return (r._data if isinstance(r, Tensor) else jnp.asarray(r)).reshape(())
+
+    def b(datas):
+        out = body_fn(*[Tensor(d) for d in datas])
+        out = out if isinstance(out, (list, tuple)) else [out]
+        return tuple(o._data if isinstance(o, Tensor) else jnp.asarray(o)
+                     for o in out)
+
+    res = jax.lax.while_loop(c, b, tuple(v._data for v in vars_t))
+    return [Tensor(r) for r in res]
+
+
+# ---- layer helpers (ref static/nn/common.py; thin over the eager layers) ----
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    from ..ops.manipulation import reshape
+    from ..ops.creation import create_parameter
+    from ..ops.math import matmul
+    import numpy as np
+    xt = x if isinstance(x, Tensor) else Tensor(_to_data(x))
+    shp = xt.shape
+    in_f = int(np.prod(shp[num_flatten_dims:]))
+    x2 = reshape(xt, list(shp[:num_flatten_dims]) + [in_f])
+    from . import create_parameter as static_create_parameter
+    w = static_create_parameter([in_f, size], "float32")
+    out = matmul(x2, w)
+    if bias_attr is not False:
+        b = static_create_parameter([size], "float32", is_bias=True)
+        out = out + b
+    if activation == "relu":
+        from ..nn.functional.activation import relu
+        out = relu(out)
+    elif activation == "tanh":
+        from ..ops.math import tanh
+        out = tanh(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None, param_attr=None,
+              dtype="float32"):
+    from . import create_parameter as static_create_parameter
+    table = static_create_parameter(list(size), dtype)
+    return apply("embedding", lambda t, i: t[i.astype(jnp.int32)],
+                 table, input)
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, **kwargs):
+    from ..nn.functional.norm import normalize
+    out = apply("static_bn",
+                lambda x: (x - jnp.mean(x, axis=0, keepdims=True)) /
+                jnp.sqrt(jnp.var(x, axis=0, keepdims=True) + epsilon), input)
+    if act == "relu":
+        from ..nn.functional.activation import relu
+        out = relu(out)
+    return out
+
+
+__all__ = ["cond", "case", "switch_case", "while_loop", "fc", "embedding",
+           "batch_norm"]

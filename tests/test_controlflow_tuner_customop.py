@@ -1,0 +1,153 @@
+"""static.nn control flow (data-dependent, under capture), launch auto-tuner,
+custom-op registration (ref static/nn/control_flow.py, auto_tuner/tuner.py,
+custom_operator.cc)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static import nn as snn
+
+
+# ---------------------------------------------------------------------------
+# control flow
+# ---------------------------------------------------------------------------
+
+def test_cond_eager_and_captured():
+    x = paddle.to_tensor(np.float32(2.0))
+    assert float(snn.cond(x > 1, lambda: x * 10, lambda: x - 1).numpy()) == 20.0
+
+    @paddle.jit.to_static
+    def f(a):
+        return snn.cond(a.sum() > 0, lambda: a * 2, lambda: a - 100)
+
+    pos = paddle.to_tensor(np.ones(3, np.float32))
+    neg = paddle.to_tensor(-np.ones(3, np.float32))
+    np.testing.assert_allclose(f(pos).numpy(), [2, 2, 2])
+    np.testing.assert_allclose(f(neg).numpy(), [-101, -101, -101])
+
+
+def test_while_loop_data_dependent_trip_count():
+    """Collatz steps: the trip count depends on the VALUE inside one compiled
+    program (the dy2static while capability)."""
+
+    @paddle.jit.to_static
+    def steps(n):
+        i = paddle.to_tensor(np.int32(0))
+
+        def cnd(n, i):
+            return n > 1
+
+        def body(n, i):
+            n2 = snn.cond((n % 2) == 0, lambda: n // 2, lambda: 3 * n + 1)
+            return n2, i + 1
+
+        n, i = snn.while_loop(cnd, body, [n, i])
+        return i
+
+    assert int(steps(paddle.to_tensor(np.int32(6))).numpy()) == 8
+    assert int(steps(paddle.to_tensor(np.int32(27))).numpy()) == 111
+
+
+def test_while_loop_eager():
+    i = paddle.to_tensor(np.int32(0))
+    s = paddle.to_tensor(np.float32(0.0))
+    i, s = snn.while_loop(lambda i, s: i < 5,
+                          lambda i, s: (i + 1, s + float(i.numpy())), [i, s])
+    assert int(i.numpy()) == 5 and float(s.numpy()) == 10.0
+
+
+def test_case_and_switch_case():
+    a = paddle.to_tensor(np.float32(3.0))
+    out = snn.case([(a > 5, lambda: a * 0), (a > 1, lambda: a * 2)],
+                   default=lambda: a)
+    assert float(out.numpy()) == 6.0
+
+    @paddle.jit.to_static
+    def g(i, x):
+        return snn.switch_case(i, {0: lambda: x, 1: lambda: x * 2},
+                               default=lambda: x * 0)
+
+    x = paddle.to_tensor(np.ones(2, np.float32))
+    np.testing.assert_allclose(g(paddle.to_tensor(np.int32(1)), x).numpy(),
+                               [2, 2])
+    np.testing.assert_allclose(g(paddle.to_tensor(np.int32(9)), x).numpy(),
+                               [0, 0])
+
+
+# ---------------------------------------------------------------------------
+# auto-tuner
+# ---------------------------------------------------------------------------
+
+def test_auto_tuner_candidates_pruned():
+    from paddle_tpu.distributed.auto_tuner import generate_candidates
+    from paddle_tpu.models.gpt import gpt_tiny
+    cfg = gpt_tiny(64)  # heads=4, layers=2
+    cands = generate_candidates(4, cfg)
+    assert cands
+    for c in cands:
+        assert c.size == 4
+        assert cfg.num_heads % c.mp == 0
+        assert cfg.num_layers % c.pp == 0
+        if c.pp > 1:
+            assert c.micro_batches % c.pp == 0
+
+
+def test_auto_tuner_finds_working_config():
+    import jax
+    from paddle_tpu.distributed.auto_tuner import tune
+    from paddle_tpu.models.gpt import gpt_tiny
+    cfg = gpt_tiny(64)
+    best, results = tune(cfg, devices=jax.devices()[:4], trial_steps=2,
+                         seq=64)
+    assert best.size == 4
+    ok = [r for r in results if r.ok]
+    assert ok and max(r.tokens_per_sec for r in ok) > 0
+    # the returned best is the argmax
+    assert best in [r.cfg for r in ok]
+
+
+# ---------------------------------------------------------------------------
+# custom ops
+# ---------------------------------------------------------------------------
+
+def test_register_custom_op_with_gradient():
+    import jax.numpy as jnp
+    from paddle_tpu.incubate import register_custom_op
+
+    # custom op: y = x^3 with a deliberately scaled custom gradient 6x^2
+    op = register_custom_op(
+        "cube_scaled_grad",
+        forward=lambda x: x ** 3,
+        backward=lambda saved, g: (g * 6 * saved[0] ** 2,))
+    x = paddle.to_tensor(np.array([2.0], np.float32))
+    x.stop_gradient = False
+    y = op(x)
+    np.testing.assert_allclose(y.numpy(), [8.0])
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [24.0])  # custom rule, not 3x^2
+
+
+def test_custom_op_from_c_kernel(tmp_path):
+    from paddle_tpu.incubate import custom_op_from_c
+    from paddle_tpu.io.shm_ring import available
+    if not available():
+        pytest.skip("no toolchain")
+    from paddle_tpu.utils.cpp_extension import load
+    src = tmp_path / "relu6c.cc"
+    src.write_text(
+        '#include <cstdint>\n'
+        'extern "C" void relu6c(const float* in, float* out, int64_t n) {\n'
+        '  for (int64_t i = 0; i < n; ++i) {\n'
+        '    float v = in[i] < 0 ? 0 : in[i];\n'
+        '    out[i] = v > 6 ? 6 : v;\n'
+        '  }\n'
+        '}\n')
+    lib = load("relu6c_ext", [str(src)])
+    op = custom_op_from_c(lib, "relu6c")
+    x = paddle.to_tensor(np.array([-1.0, 3.0, 9.0], np.float32))
+    np.testing.assert_allclose(op(x).numpy(), [0.0, 3.0, 6.0])
+    # works inside a captured program too (pure_callback under jit)
+    st = paddle.jit.to_static(lambda t: op(t) * 2)
+    np.testing.assert_allclose(st(x).numpy(), [0.0, 6.0, 12.0])
