@@ -137,6 +137,15 @@ def _as_list(x):
     return [x]
 
 
+# callbacks invoked when a full (non-partial) backward traversal completes
+_post_backward_callbacks = []
+
+
+def register_post_backward_callback(cb):
+    _post_backward_callbacks.append(cb)
+    return cb
+
+
 def run_backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
                  retain_graph: bool = False) -> None:
     """Full backward from seeds, accumulating into leaf `.grad` (`RunBackward` parity)."""
@@ -433,6 +442,11 @@ def _engine_impl(tensors, grad_tensors, retain_graph, inputs, create_graph,
                 leaf_hit(inp, ic)
 
     if not partial:
+        # post-backward callbacks (DataParallel bucket flush etc.): the engine
+        # is the only place that knows the traversal truly finished — counting
+        # leaf-hook fires cannot (shared params fire once per consumer edge)
+        for cb in list(_post_backward_callbacks):
+            cb()
         return None
     out = []
     for t in inputs:

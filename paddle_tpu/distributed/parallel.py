@@ -12,6 +12,7 @@ from __future__ import annotations
 import contextlib
 
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.tensor import Tensor
 from ..nn.layer.layers import Layer
@@ -44,21 +45,69 @@ class DataParallel(Layer):
         self._register_hooks()
 
     def _register_hooks(self):
+        """Bucketed gradient fusion (ref EagerReducer, reducer.cc:740):
+        grads join a bucket as their hooks fire (reverse autograd order); when
+        a bucket fills (comm_buffer_size MB) or the last grad arrives, ONE
+        fused flat allreduce runs and the averaged slices are scattered back."""
         if self._world <= 1:
             return
         world = self._world
         group = self.group
         dp = self
+        params = [p for p in self._layers.parameters() if not p.stop_gradient]
+        self._bucket = []           # [(param, local partial-grad data)]
+        self._bucket_bytes = 0
+        cap = int(self.comm_buffer_size * (1 << 20))
 
-        for p in self._layers.parameters():
-            if p.stop_gradient:
-                continue
+        def flush(current_param=None):
+            """Fused allreduce of the bucket.  Every entry is a PARTIAL local
+            cotangent (shared params fire once per consumer edge; averaging is
+            linear so per-partial averages sum correctly).  Entries other than
+            the currently-firing param already had their local partial
+            accumulated into .grad by the engine, so they are corrected with
+            += (avg - local) — which also preserves grads accumulated under
+            no_sync.  The current param's averaged partial is returned for the
+            engine's own accumulation."""
+            if not dp._bucket:
+                return None
+            entries = dp._bucket
+            dp._bucket = []
+            dp._bucket_bytes = 0
+            flat = jnp.concatenate([jnp.ravel(g) for _, g in entries])
+            ft = Tensor(flat)
+            all_reduce(ft, ReduceOp.SUM, group=group)
+            ret = None
+            off = 0
+            for _p, g in entries:
+                n = int(np.prod(g.shape))
+                avg = (ft._data[off:off + n] / world).reshape(g.shape)
+                off += n
+                if _p is current_param:
+                    ret = Tensor(avg, stop_gradient=True)
+                elif _p.grad is not None:
+                    _p.grad._data = _p.grad._data + (avg - g)
+                else:  # engine write raced? fall back to the averaged value
+                    gt = Tensor(avg, stop_gradient=True)
+                    gt.persistable = True
+                    _p.grad = gt
+            return ret
 
+        self._flush_bucket = flush
+        # the remainder bucket flushes when the ENGINE reports the backward
+        # finished — hook-fire counting cannot detect completion (shared
+        # params fire per consumer edge, unused params never fire)
+        from ..core import autograd as _ag
+        _ag.register_post_backward_callback(lambda: flush(None))
+
+        for p in params:
             def hook(grad, _p=p):
                 if not dp._enable_sync:
                     return grad
-                all_reduce(grad, ReduceOp.SUM, group=group)
-                return Tensor(grad._data / world, stop_gradient=True)
+                dp._bucket.append((_p, grad._data))
+                dp._bucket_bytes += grad._data.size * grad._data.dtype.itemsize
+                if dp._bucket_bytes >= cap:
+                    return flush(_p)
+                return grad
             p.register_hook(hook)
 
     @contextlib.contextmanager

@@ -348,6 +348,16 @@ class GPTForCausalLM(Layer):
             return forward(tree, tokens, cfg)
         return apply("gpt_forward", h, *datas)
 
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
+                 top_k=None, eos_token_id=None):
+        """KV-cache autoregressive decoding (see module-level `generate`)."""
+        ids = input_ids._data if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        out = generate(self.params_pytree(), ids, self.config,
+                       max_new_tokens=max_new_tokens, temperature=temperature,
+                       top_k=top_k, eos_token_id=eos_token_id)
+        return Tensor(out)
+
     def params_pytree(self):
         """Raw jnp pytree view (shared buffers) for the compiled trainer."""
         return jax.tree_util.tree_unflatten(
@@ -357,3 +367,208 @@ class GPTForCausalLM(Layer):
         flat, _ = jax.tree_util.tree_flatten(tree)
         for p, d in zip(self._flat_params, flat):
             p._data = d
+
+
+def llama_tiny(seq_len=128):
+    """Llama-architecture preset (RMSNorm + SiLU + untied head)."""
+    return GPTConfig(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                     max_seq_len=seq_len, use_rms_norm=True, activation="silu",
+                     tie_word_embeddings=False, intermediate_size=172)
+
+
+def llama2_7b():
+    """Llama-2 7B shape family (ref PaddleNLP llama configs)."""
+    return GPTConfig(vocab_size=32000, hidden_size=4096, num_layers=32,
+                     num_heads=32, max_seq_len=4096, use_rms_norm=True,
+                     activation="silu", tie_word_embeddings=False,
+                     intermediate_size=11008)
+
+
+# ---------------------------------------------------------------------------
+# KV-cache autoregressive decoding (ref PaddleNLP generation + fused
+# variable-length attention; TPU-native: static-shape cache + lax.scan decode)
+# ---------------------------------------------------------------------------
+
+def init_cache(config: GPTConfig, batch: int, max_len: int):
+    """Per-layer KV cache [L, B, max_len, H, hd] (static shapes for jit)."""
+    c = config
+    shape = (c.num_layers, batch, max_len, c.num_heads, c.head_dim)
+    return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype)}
+
+
+def decode_step(params, token, cache, pos, config: GPTConfig):
+    """One autoregressive step: token [B] int32 at position `pos` (traced).
+
+    Returns (logits [B, V], updated cache).  Attention is a dense dot against
+    the cache with a position mask — at decode T=1 the MXU matmul IS the
+    fused path; no flash kernel needed.
+    """
+    c = config
+    B = token.shape[0]
+    D, H, hd = c.hidden_size, c.num_heads, c.head_dim
+    x = jnp.take(params["wte"], token, axis=0)               # [B, D]
+    if not c.use_rope:
+        x = x + jax.lax.dynamic_index_in_dim(params["wpe"], pos, keepdims=False)
+
+    max_len = cache["k"].shape[2]
+    kv_pos = jnp.arange(max_len)
+
+    def layer(x, layer_in):
+        bp, kc, vc = layer_in                                 # caches [B,S,H,hd]
+        h = _norm(x, bp["ln1_w"], bp["ln1_b"], c)
+        qkv = jnp.matmul(h, bp["qkv_w"]) + bp["qkv_b"]        # [B, 3D]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, H, hd)
+        k = k.reshape(B, H, hd)
+        v = v.reshape(B, H, hd)
+        if c.use_rope:
+            sin, cos = _rope_tables(c, 1, pos_offset=pos)
+            q = apply_rope(q[:, None], sin, cos)[:, 0]
+            k = apply_rope(k[:, None], sin, cos)[:, 0]
+        kc = jax.lax.dynamic_update_slice_in_dim(kc, k[:, None], pos, axis=1)
+        vc = jax.lax.dynamic_update_slice_in_dim(vc, v[:, None], pos, axis=1)
+        s = jnp.einsum("bhd,bshd->bhs", q, kc,
+                       preferred_element_type=jnp.float32) / math.sqrt(hd)
+        s = jnp.where((kv_pos <= pos)[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("bhs,bshd->bhd", p.astype(vc.dtype), vc)
+        x = x + jnp.matmul(attn.reshape(B, D), bp["proj_w"]) + bp["proj_b"]
+        h = _norm(x, bp["ln2_w"], bp["ln2_b"], c)
+        if c.moe_num_experts > 0:
+            from ..incubate.distributed.models.moe.dispatch import moe_ffn_dense
+            y, _ = moe_ffn_dense(bp, h, c)
+            return x + y, (kc, vc)
+        h = jnp.matmul(h, bp["fc1_w"]) + bp["fc1_b"]
+        h = jax.nn.gelu(h) if c.activation == "gelu" else jax.nn.silu(h)
+        return x + jnp.matmul(h, bp["fc2_w"]) + bp["fc2_b"], (kc, vc)
+
+    def scan_body(carry, inp):
+        out, kv = layer(carry, inp)
+        return out, kv
+
+    x, (new_k, new_v) = jax.lax.scan(
+        scan_body, x, (params["blocks"], cache["k"], cache["v"]))
+    x = _norm(x, params["lnf_w"], params["lnf_b"], c)
+    head = params["wte"].T if c.tie_word_embeddings else params["lm_head"]
+    return jnp.matmul(x, head), {"k": new_k, "v": new_v}
+
+
+def prefill(params, input_ids, config: GPTConfig, cache):
+    """One batched forward over the prompt that also fills the KV cache.
+
+    Returns (last-position logits [B, V], cache with positions [0, Tp) set).
+    The prompt runs as ONE dense pass (MXU-sized matmuls + causal attention),
+    not Tp serial decode steps.
+    """
+    c = config
+    B, Tp = input_ids.shape
+    D, H, hd = c.hidden_size, c.num_heads, c.head_dim
+    x = jnp.take(params["wte"], input_ids, axis=0)
+    if not c.use_rope:
+        x = x + params["wpe"][:Tp]
+
+    def layer(x, layer_in):
+        bp, kc, vc = layer_in
+        h = _norm(x, bp["ln1_w"], bp["ln1_b"], c)
+        qkv = jnp.matmul(h, bp["qkv_w"]) + bp["qkv_b"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, Tp, H, hd)
+        k = k.reshape(B, Tp, H, hd)
+        v = v.reshape(B, Tp, H, hd)
+        if c.use_rope:
+            sin, cos = _rope_tables(c, Tp)
+            q = apply_rope(q, sin, cos)
+            k = apply_rope(k, sin, cos)
+        kc = jax.lax.dynamic_update_slice_in_dim(kc, k, 0, axis=1)
+        vc = jax.lax.dynamic_update_slice_in_dim(vc, v, 0, axis=1)
+        attn = flash_attention_fused(q, k, v, causal=True).reshape(B, Tp, D)
+        x = x + jnp.matmul(attn, bp["proj_w"]) + bp["proj_b"]
+        h = _norm(x, bp["ln2_w"], bp["ln2_b"], c)
+        if c.moe_num_experts > 0:
+            from ..incubate.distributed.models.moe.dispatch import moe_ffn_dense
+            y, _ = moe_ffn_dense(bp, h.reshape(B * Tp, D), c)
+            return x + y.reshape(B, Tp, D), (kc, vc)
+        h = jnp.matmul(h, bp["fc1_w"]) + bp["fc1_b"]
+        h = jax.nn.gelu(h) if c.activation == "gelu" else jax.nn.silu(h)
+        return x + jnp.matmul(h, bp["fc2_w"]) + bp["fc2_b"], (kc, vc)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        lambda carry, inp: layer(carry, inp),
+        x, (params["blocks"], cache["k"], cache["v"]))
+    x = _norm(x[:, -1], params["lnf_w"], params["lnf_b"], c)
+    head = params["wte"].T if c.tie_word_embeddings else params["lm_head"]
+    return jnp.matmul(x, head), {"k": new_k, "v": new_v}
+
+
+_generate_cache: Dict[Any, Any] = {}
+
+
+def generate(params, input_ids, config: GPTConfig, max_new_tokens: int = 32,
+             temperature: float = 0.0, top_k: Optional[int] = None,
+             eos_token_id: Optional[int] = None, key=None):
+    """Greedy / temperature sampling with a KV cache: one batched prefill
+    pass, then a decode lax.scan — the WHOLE loop is one cached jitted
+    program (repeat calls with the same shapes reuse the executable).
+    Sequences that emit eos_token_id are frozen at EOS from then on.
+
+    input_ids [B, T_prompt] int32 -> [B, T_prompt + max_new_tokens].
+    """
+    B, Tp = input_ids.shape
+    total = Tp + max_new_tokens
+    if not config.use_rope and total > config.max_seq_len:
+        raise ValueError(
+            f"prompt {Tp} + max_new_tokens {max_new_tokens} exceeds "
+            f"max_seq_len {config.max_seq_len} (learned positions)")
+    if key is None:
+        key = jax.random.key(0)
+    sample = bool(temperature and temperature > 0.0)
+
+    cache_key = (dataclasses.astuple(config), B, Tp, max_new_tokens,
+                 sample, top_k, eos_token_id)
+    fn = _generate_cache.get(cache_key)
+    if fn is None:
+        def impl(params, ids, temp, key):
+            kv = init_cache(config, B, total)
+
+            def pick(logits, key_):
+                if sample:
+                    key_, sub = jax.random.split(key_)
+                    lg = logits / temp
+                    if top_k:
+                        kth = jax.lax.top_k(lg, top_k)[0][:, -1:]
+                        lg = jnp.where(lg < kth, -1e30, lg)
+                    return (jax.random.categorical(sub, lg).astype(jnp.int32),
+                            key_)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), key_
+
+            logits, kv = prefill(params, ids, config, kv)
+            first, key = pick(logits, key)
+            finished0 = (first == eos_token_id) if eos_token_id is not None \
+                else jnp.zeros((B,), bool)
+            tokens = jnp.concatenate(
+                [ids, first[:, None],
+                 jnp.zeros((B, max_new_tokens - 1), jnp.int32)], axis=1)
+
+            def step(carry, pos):
+                tokens, kv, key_, finished = carry
+                tok = jax.lax.dynamic_index_in_dim(tokens, pos, axis=1,
+                                                   keepdims=False)
+                logits, kv = decode_step(params, tok, kv, pos, config)
+                nxt, key_ = pick(logits, key_)
+                if eos_token_id is not None:
+                    nxt = jnp.where(finished, eos_token_id, nxt)
+                    finished = finished | (nxt == eos_token_id)
+                tokens = jax.lax.dynamic_update_slice_in_dim(
+                    tokens, nxt[:, None], pos + 1, axis=1)
+                return (tokens, kv, key_, finished), None
+
+            if max_new_tokens > 1:
+                (tokens, _, _, _), _ = jax.lax.scan(
+                    step, (tokens, kv, key, finished0),
+                    jnp.arange(Tp, total - 1))
+            return tokens
+
+        fn = jax.jit(impl)
+        _generate_cache[cache_key] = fn
+    return fn(params, jnp.asarray(input_ids, jnp.int32),
+              jnp.asarray(temperature if sample else 1.0, jnp.float32), key)
