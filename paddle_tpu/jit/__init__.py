@@ -17,3 +17,4 @@ def set_code_level(level=100, also_to_stdout=False):
 
 def set_verbosity(level=0, also_to_stdout=False):
     return None
+from . import dy2static  # noqa  (AST control-flow conversion)

@@ -1,0 +1,311 @@
+"""dy2static — AST rewriting of Python control flow on tensor values.
+
+Reference parity: `python/paddle/jit/dy2static/` (`ast_transformer.py` rewrites
+`if`/`while` statements into `convert_ifelse`/`convert_while_loop` calls;
+`convert_operators.py` dispatches tensor-valued predicates to control-flow ops
+and python values to plain python).
+
+TPU-native: the converted calls land on `static.nn.cond` (both-branch select)
+and `static.nn.while_loop` (`jax.lax.while_loop`) under capture, plain Python
+eagerly.  `StaticFunction` applies the transform lazily: the untransformed
+function traces first, and only a tensor-bool error during tracing triggers
+the rewrite + retrace — existing traces never change.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import types
+from typing import Callable, Set
+
+
+# ---------------------------------------------------------------------------
+# runtime converters (ref convert_operators.py)
+# ---------------------------------------------------------------------------
+
+def convert_ifelse(pred, true_fn, false_fn, args):
+    """ref convert_ifelse: tensor pred -> cond op, python pred -> branch."""
+    from ..core.tensor import Tensor
+    import jax
+    if isinstance(pred, Tensor) and isinstance(pred._data, jax.core.Tracer):
+        from ..static.nn import cond
+        return cond(pred, lambda: true_fn(*args), lambda: false_fn(*args))
+    taken = bool(pred._data) if isinstance(pred, Tensor) else bool(pred)
+    return true_fn(*args) if taken else false_fn(*args)
+
+
+def convert_while_loop(cond_fn, body_fn, args):
+    """ref convert_while_loop: tensor condition -> while op, else python."""
+    from ..core.tensor import Tensor
+    import jax
+    first = cond_fn(*args)
+    traced = (isinstance(first, Tensor)
+              and isinstance(first._data, jax.core.Tracer)) or \
+        any(isinstance(a, Tensor) and isinstance(a._data, jax.core.Tracer)
+            for a in args)
+    if traced:
+        from ..static.nn import while_loop
+        out = while_loop(cond_fn, lambda *a: tuple(body_fn(*a)), list(args))
+        return tuple(out)
+    vals = tuple(args)
+    while bool(first._data) if isinstance(first, Tensor) else bool(first):
+        vals = tuple(body_fn(*vals))
+        first = cond_fn(*vals)
+    return vals
+
+
+# ---------------------------------------------------------------------------
+# AST transformer (ref ast_transformer.py IfElse/Loop transformers)
+# ---------------------------------------------------------------------------
+
+class _StoredNames(ast.NodeVisitor):
+    def __init__(self):
+        self.names: Set[str] = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Store):
+            self.names.add(node.id)
+
+    def visit_AugAssign(self, node):
+        if isinstance(node.target, ast.Name):
+            self.names.add(node.target.id)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        self.names.add(node.name)  # don't descend into nested scopes
+
+
+def _stored(nodes) -> Set[str]:
+    v = _StoredNames()
+    for n in nodes:
+        v.visit(n)
+    return v.names
+
+
+def _loaded(nodes) -> Set[str]:
+    out: Set[str] = set()
+    for n in nodes:
+        for sub in ast.walk(n):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                out.add(sub.id)
+    return out
+
+
+def _certainly_stored(stmt) -> Set[str]:
+    """Names DEFINITELY bound after executing stmt (conditional branches count
+    only when both sides bind; loops may run zero times -> nothing counts)."""
+    if isinstance(stmt, ast.If):
+        return (_certain_all(stmt.body) & _certain_all(stmt.orelse))
+    if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+        return set()
+    if isinstance(stmt, (ast.Try,)):
+        return set()
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return _certain_all(stmt.body) | _stored(
+            [i.optional_vars for i in stmt.items if i.optional_vars is not None])
+    return _stored([stmt])
+
+
+def _certain_all(stmts) -> Set[str]:
+    out: Set[str] = set()
+    for s in stmts:
+        out |= _certainly_stored(s)
+    return out
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    """Rewrites `if`/`while` whose out-vars are known before the statement.
+
+    Simplifications vs the reference (documented): no `break`/`continue`/
+    `return` inside converted bodies, out-vars must be bound before the
+    statement (else the statement is left as plain Python)."""
+
+    def __init__(self):
+        self._defined: Set[str] = set()
+        self._uid = 0
+
+    def _fresh(self, base):
+        self._uid += 1
+        return f"__jst_{base}_{self._uid}"
+
+    # track CERTAIN sequential definitions (conditionally-bound names must not
+    # be read by a converted statement's args tuple -> UnboundLocalError)
+    def _note_defined(self, stmt):
+        self._defined |= _certainly_stored(stmt)
+
+    def visit_FunctionDef(self, node):
+        a = node.args
+        params = {p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)}
+        if a.vararg:
+            params.add(a.vararg.arg)
+        if a.kwarg:
+            params.add(a.kwarg.arg)
+        # nested defs get their own scope state (restore the outer one after)
+        saved_defined, saved_rest = self._defined, getattr(self, "_rest", [])
+        self._defined = params
+        new_body = []
+        for i, stmt in enumerate(node.body):
+            self._rest = node.body[i + 1:]   # lookahead for while out-vars
+            res = self.visit(stmt)
+            if isinstance(res, list):
+                new_body.extend(res)
+            elif res is not None:
+                new_body.append(res)
+            self._note_defined(stmt)
+        node.body = new_body
+        self._defined = saved_defined
+        self._rest = saved_rest
+        return node
+
+    @staticmethod
+    def _has_escape(nodes) -> bool:
+        """Return/break/continue/yield in THIS scope (nested function defs —
+        including converted branch fns — have their own scope)."""
+        def walk(n):
+            for child in ast.iter_child_nodes(n):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue
+                if isinstance(child, (ast.Return, ast.Break, ast.Continue,
+                                      ast.Yield, ast.YieldFrom)):
+                    return True
+                if walk(child):
+                    return True
+            return False
+
+        for n in nodes:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            if isinstance(n, (ast.Return, ast.Break, ast.Continue)):
+                return True
+            if walk(n):
+                return True
+        return False
+
+    def _make_branch_fn(self, name, out_vars, body):
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=v, ctx=ast.Load()) for v in out_vars],
+            ctx=ast.Load()))
+        return ast.FunctionDef(
+            name=name,
+            args=ast.arguments(
+                posonlyargs=[], kwonlyargs=[], kw_defaults=[], defaults=[],
+                args=[ast.arg(arg=v) for v in out_vars]),
+            body=(body or [ast.Pass()]) + [ret],
+            decorator_list=[])
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        t_stored, f_stored = _stored(node.body), _stored(node.orelse)
+        # out-vars: bound before the statement, OR introduced by BOTH branches
+        out_vars = sorted(((t_stored | f_stored) & self._defined)
+                          | (t_stored & f_stored))
+        if not out_vars or self._has_escape(node.body + node.orelse):
+            return node
+        tname, fname = self._fresh("true"), self._fresh("false")
+        tfn = self._make_branch_fn(tname, out_vars, list(node.body))
+        ffn = self._make_branch_fn(fname, out_vars, list(node.orelse))
+
+        def arg_of(v):
+            if v in self._defined:
+                return ast.Name(id=v, ctx=ast.Load())
+            return ast.Constant(value=None)  # both branches rebind it
+
+        call = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=v, ctx=ast.Store()) for v in out_vars],
+                ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Name(id="__jst_convert_ifelse", ctx=ast.Load()),
+                args=[node.test,
+                      ast.Name(id=tname, ctx=ast.Load()),
+                      ast.Name(id=fname, ctx=ast.Load()),
+                      ast.Tuple(elts=[arg_of(v) for v in out_vars],
+                                ctx=ast.Load())],
+                keywords=[]))
+        return [tfn, ffn, call]
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        stored = _stored(node.body)
+        out_vars = sorted(stored & self._defined)
+        if not out_vars or node.orelse or self._has_escape(node.body):
+            return node
+        # a body-introduced name read AFTER the loop would vanish inside the
+        # generated body fn: leave such loops as plain Python (the original
+        # tracer error then points the user at the unsupported shape)
+        escaping = (stored - self._defined) & _loaded(
+            getattr(self, "_rest", []))
+        if escaping:
+            return node
+        cname, bname = self._fresh("cond"), self._fresh("body")
+        cfn = ast.FunctionDef(
+            name=cname,
+            args=ast.arguments(
+                posonlyargs=[], kwonlyargs=[], kw_defaults=[], defaults=[],
+                args=[ast.arg(arg=v) for v in out_vars]),
+            body=[ast.Return(value=node.test)],
+            decorator_list=[])
+        bfn = self._make_branch_fn(bname, out_vars, list(node.body))
+        call = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=v, ctx=ast.Store()) for v in out_vars],
+                ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Name(id="__jst_convert_while", ctx=ast.Load()),
+                args=[ast.Name(id=cname, ctx=ast.Load()),
+                      ast.Name(id=bname, ctx=ast.Load()),
+                      ast.Tuple(elts=[ast.Name(id=v, ctx=ast.Load())
+                                      for v in out_vars], ctx=ast.Load())],
+                keywords=[]))
+        return [cfn, bfn, call]
+
+
+def ast_transform(fn: Callable) -> Callable:
+    """Rewrite fn's if/while statements; returns the transformed function
+    (raises on unsupported sources — callers fall back to the original)."""
+    src = textwrap.dedent(inspect.getsource(fn))
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    # strip decorators (the transform runs under to_static already)
+    if isinstance(fdef, ast.FunctionDef):
+        fdef.decorator_list = []
+    tree = _ControlFlowTransformer().visit(tree)
+    ast.fix_missing_locations(tree)
+    code = compile(tree, filename=f"<dy2static {fn.__name__}>", mode="exec")
+
+    class _Env(dict):
+        """Overlay namespace: helper names + closure snapshots resolve here,
+        everything else falls through LIVE to the function's real globals (a
+        dict copy would freeze later module-level mutations)."""
+
+        def __missing__(self, k):
+            return fn.__globals__[k]
+
+    glb = _Env()
+    glb["__jst_convert_ifelse"] = convert_ifelse
+    glb["__jst_convert_while"] = convert_while_loop
+    glb["__builtins__"] = fn.__globals__.get("__builtins__", __builtins__)
+    # closure cells snapshot by value (transformed code has no closure);
+    # later cell mutations are not observed — a documented limitation
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            glb[name] = cell.cell_contents
+    ns: dict = {}
+    exec(code, glb, ns)
+    new_fn = ns[fn.__name__]
+    if isinstance(fn, types.MethodType):
+        new_fn = types.MethodType(new_fn, fn.__self__)
+    return functools.wraps(fn)(new_fn)
+
+
+def convert_call(fn):
+    """ref convert_call: nested callables pass through (tracing follows them)."""
+    return fn
+
+
+__all__ = ["ast_transform", "convert_ifelse", "convert_while_loop",
+           "convert_call"]

@@ -262,11 +262,40 @@ class StaticFunction:
             or any(isinstance(a, Tensor) and not a.stop_gradient for a in args))
         key = CacheKey.make(args, kwargs, training, with_grad)
         in_datas, in_tree = _flatten_inputs(args, kwargs)
-        prog = self._cache.get(key)
-        if prog is None:
+
+        def build():
             pure_fn, params, buffers = functionalize(self._fn, layer)
             pure_fn._in_tree = in_tree
             prog = ConcreteProgram(pure_fn, params, buffers, in_tree)
             self._cache[key] = prog
+            return prog
+
+        prog = self._cache.get(key)
+        fresh = prog is None
+        if fresh:
+            prog = build()
         input_tensors = [a for a in args if isinstance(a, Tensor)]
-        return prog.run(in_datas, with_grad, input_tensors)
+        try:
+            return prog.run(in_datas, with_grad, input_tensors)
+        except Exception as e:  # dy2static retry on tensor control flow
+            import jax
+            cf_error = isinstance(
+                e, (jax.errors.TracerBoolConversionError,
+                    jax.errors.TracerArrayConversionError,
+                    jax.errors.ConcretizationTypeError)) or \
+                (isinstance(e, ValueError) and "truth value" in str(e).lower())
+            if not fresh or not cf_error or \
+                    getattr(self, "_ast_transformed", False):
+                raise
+            # Python `if`/`while` hit a traced tensor: rewrite the source AST
+            # to convert_ifelse/convert_while (ref dy2static ast_transformer)
+            # and retrace — untransformable sources re-raise the original
+            from .dy2static import ast_transform
+            try:
+                self._fn = ast_transform(self._fn)
+            except Exception:
+                raise e
+            self._ast_transformed = True
+            self._cache.pop(key, None)
+            prog = build()
+            return prog.run(in_datas, with_grad, input_tensors)
