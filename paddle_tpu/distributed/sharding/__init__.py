@@ -137,6 +137,18 @@ class GroupShardedStage3(Layer):
         env = ParallelEnv()
         self._rank = env.rank if group is None else group.get_group_rank(env.rank)
         self._world = env.world_size if group is None else group.nranks
+        # offload (ref group_sharded offload=True): the resident param slice
+        # (and therefore the optimizer state built on it) lives on HOST memory;
+        # gather stages it back to the accelerator.  Updates on offloaded
+        # slices execute on the CPU backend, like the reference's CPU adam.
+        self._offload = offload
+        self._host = None
+        if offload:
+            import jax
+            try:
+                self._host = jax.local_devices(backend="cpu")[0]
+            except RuntimeError:
+                self._host = None  # no CPU backend: offload degrades to no-op
         self._registry = []  # (param, full_shape, padded_len)
         if self._world > 1:
             for p in layer.parameters():
@@ -151,15 +163,23 @@ class GroupShardedStage3(Layer):
 
     # ---- shard/gather primitives ----
     def _reshard_param(self, p, full_shape, padded):
+        import jax
         chunk = padded // self._world
         flat = jnp.ravel(p._data)
         flat = jnp.pad(flat, (0, padded - flat.size))
-        p._data = flat[self._rank * chunk:(self._rank + 1) * chunk]
+        sl = flat[self._rank * chunk:(self._rank + 1) * chunk]
+        if self._offload and self._host is not None:
+            sl = jax.device_put(sl, self._host)
+        p._data = sl
 
     def _gather_param(self, p, full_shape, padded):
+        import jax
         from ..communication.ops import all_gather
+        local = p._data
+        if self._offload and self._host is not None:
+            local = jax.device_put(local, jax.local_devices()[0])  # to device
         pieces = []
-        all_gather(pieces, Tensor(p._data, stop_gradient=True), group=self._group)
+        all_gather(pieces, Tensor(local, stop_gradient=True), group=self._group)
         flat = jnp.concatenate([t._data for t in pieces])
         n = int(np.prod(full_shape)) if full_shape else 1
         p._data = flat[:n].reshape(full_shape)
@@ -199,12 +219,20 @@ class GroupShardedStage3(Layer):
         if self._world > 1:
             self.get_all_parameters()
             sd = self._layer.state_dict(*a, **kw)
+            # snapshot values while FULL: sd entries are the live params, whose
+            # storage drops back to the slice on the reshard below
+            sd = {k: Tensor(v._data, stop_gradient=True)
+                  if isinstance(v, Tensor) else v for k, v in sd.items()}
             for p, shape, padded in self._registry:
                 self._reshard_param(p, shape, padded)
             return sd
         return self._layer.state_dict(*a, **kw)
 
     def set_state_dict(self, sd, *a, **kw):
+        if self._world > 1:
+            # live params are 1-D slices; materialize full shapes so the
+            # full-shape checkpoint loads, then drop back to slices
+            self.get_all_parameters()
         res = self._layer.set_state_dict(sd, *a, **kw)
         for p, shape, padded in self._registry:
             self._reshard_param(p, shape, padded)
@@ -217,12 +245,53 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
                            exclude_layer=None):
     """(reference `group_sharded.py` group_sharded_parallel)."""
     assert level in ("os", "os_g", "p_g_os")
+    if level == "p_g_os":
+        # stage 3: every rank owns a 1/world SLICE of every param, so every
+        # rank steps all its slice-params with the raw optimizer — the stage-1
+        # owner/broadcast split would overwrite other ranks' slices
+        from ...nn.clip import ClipGradByGlobalNorm
+        if isinstance(getattr(optimizer, "_grad_clip", None),
+                      ClipGradByGlobalNorm):
+            # each rank sees only slice grads: the squared norm must reduce
+            # across the sharding group before clipping (ref stage-3 clip)
+            optimizer._grad_clip = _ShardedClipGradByGlobalNorm(
+                optimizer._grad_clip.clip_norm, group)
+        wrapped = GroupShardedStage3(model, optimizer, group=group,
+                                     offload=offload)
+        return wrapped, optimizer, scaler
     sharded_opt = DygraphShardingOptimizer(optimizer)
     if level == "os":
         return model, sharded_opt, scaler
-    cls = GroupShardedStage2 if level == "os_g" else GroupShardedStage3
-    wrapped = cls(model, sharded_opt, group=group)
+    wrapped = GroupShardedStage2(model, sharded_opt, group=group)
     return wrapped, sharded_opt, scaler
+
+
+class _ShardedClipGradByGlobalNorm:
+    """ClipGradByGlobalNorm over slice-sharded grads: local sum-of-squares is
+    all-reduced across the sharding group so every rank clips with the TRUE
+    global norm (ref group_sharded clip)."""
+
+    def __init__(self, clip_norm, group=None):
+        self.clip_norm = float(clip_norm)
+        self._group = group
+
+    def __call__(self, params_grads):
+        sumsq = jnp.zeros((), jnp.float32)
+        for _p, g in params_grads:
+            if g is not None:
+                sumsq = sumsq + jnp.sum(jnp.square(g._data.astype(jnp.float32)))
+        t = Tensor(sumsq[None], stop_gradient=True)
+        all_reduce(t, ReduceOp.SUM, group=self._group)
+        norm = jnp.sqrt(t._data[0])
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, Tensor((g._data * scale).astype(g._data.dtype),
+                                  stop_gradient=True)))
+        return out
 
 
 def save_group_sharded_model(model, output, optimizer=None):

@@ -1,0 +1,63 @@
+"""GroupShardedStage3 (+offload) trainer for the multi-process harness:
+param-sharded training must match the serial run, and each rank's resident
+param bytes must shrink ~world x (ref group_sharded_stage3.py)."""
+import json
+import os
+
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=1"
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+
+def build():
+    import paddle_tpu.nn as nn
+    paddle.framework.random.seed(77)
+    return nn.Sequential(nn.Linear(16, 64), nn.ReLU(), nn.Linear(64, 4))
+
+
+def run(world, rank, offload):
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed.sharding import group_sharded_parallel
+    model = build()
+    full_bytes = sum(p._data.size * p._data.dtype.itemsize
+                     for p in model.parameters())
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    if world > 1:
+        model, opt, _ = group_sharded_parallel(model, opt, "p_g_os",
+                                               offload=offload)
+        resident = sum(p._data.size * p._data.dtype.itemsize
+                       for p in model.parameters())
+    else:
+        resident = full_bytes
+    rng = np.random.RandomState(3)
+    X = rng.randn(16, 16).astype(np.float32)
+    Y = rng.randint(0, 4, (16,)).astype(np.int64)
+    losses = []
+    for _ in range(3):
+        out = model(paddle.to_tensor(X))
+        loss = F.cross_entropy(out, paddle.to_tensor(Y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss._data))
+    sd = model.state_dict()
+    ps = sum(float(np.abs(np.asarray(v._data)).sum()) for v in sd.values())
+    return losses, ps, full_bytes, resident
+
+
+def main():
+    env = dist.init_parallel_env()
+    offload = os.environ.get("STAGE3_OFFLOAD", "0") == "1"
+    losses, ps, full, resident = run(env.world_size, env.rank, offload)
+    print("S3RESULT " + json.dumps(
+        {"rank": env.rank, "losses": losses, "param_sum": ps,
+         "full_bytes": full, "resident_bytes": resident}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -20,8 +20,9 @@ SCRIPTS = os.path.join(os.path.dirname(__file__), "dist_scripts")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _launch(script, nproc, tmp_path, timeout=240):
+def _launch(script, nproc, tmp_path, timeout=240, env_extra=None):
     env = dict(os.environ)
+    env.update(env_extra or {})
     env["PADDLE_DIST_DEVICE"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     log_dir = str(tmp_path / "logs")
@@ -80,3 +81,28 @@ def test_dataparallel_loss_parity_vs_serial(tmp_path):
     # (identical params, disjoint equal shards)
     step0 = (results[0]["losses"][0] + results[1]["losses"][0]) / 2
     np.testing.assert_allclose(step0, serial_losses[0], rtol=1e-4)
+
+
+@pytest.mark.parametrize("offload", ["0", "1"], ids=["hbm", "offload"])
+def test_group_sharded_stage3_parity_and_memory(tmp_path, offload):
+    """ZeRO-3 eager: loss parity vs serial AND ~world-x resident param
+    shrinkage, with and without host offload (ref group_sharded_stage3)."""
+    proc, logdict = _launch("stage3_parity.py", 2, tmp_path,
+                            env_extra={"STAGE3_OFFLOAD": offload})
+    logs = "\n".join(logdict.values())
+    assert proc.returncode == 0, f"rc={proc.returncode}\n{proc.stdout}\n{logs}"
+    results = [json.loads(m) for m in re.findall(r"S3RESULT (.*)", logs)]
+    assert len(results) == 2, logs
+
+    sys.path.insert(0, SCRIPTS)
+    try:
+        import stage3_parity
+        serial_losses, serial_ps, _, _ = stage3_parity.run(1, 0, False)
+    finally:
+        sys.path.pop(0)
+
+    for r in results:
+        np.testing.assert_allclose(r["losses"], serial_losses, rtol=1e-4)
+        np.testing.assert_allclose(r["param_sum"], serial_ps, rtol=1e-4)
+        # resident bytes shrink ~2x (padding allows slack)
+        assert r["resident_bytes"] < 0.75 * r["full_bytes"]
