@@ -15,6 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...core.tensor import Tensor
+from ...nn.clip import ClipGradByGlobalNorm as _ClipGradByGlobalNorm
 from ...nn.layer.layers import Layer
 from ..communication.ops import ReduceOp, all_reduce, broadcast
 from ..parallel_env import ParallelEnv
@@ -245,17 +246,26 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
                            exclude_layer=None):
     """(reference `group_sharded.py` group_sharded_parallel)."""
     assert level in ("os", "os_g", "p_g_os")
+    if level != "p_g_os" and offload:
+        import warnings
+        warnings.warn("group_sharded_parallel: offload is implemented for "
+                      "level='p_g_os' only; levels os/os_g keep state on the "
+                      "accelerator")
     if level == "p_g_os":
         # stage 3: every rank owns a 1/world SLICE of every param, so every
         # rank steps all its slice-params with the raw optimizer — the stage-1
         # owner/broadcast split would overwrite other ranks' slices
-        from ...nn.clip import ClipGradByGlobalNorm
-        if isinstance(getattr(optimizer, "_grad_clip", None),
-                      ClipGradByGlobalNorm):
+        from ...nn.clip import ClipGradByGlobalNorm, ClipGradByNorm
+        clip = getattr(optimizer, "_grad_clip", None)
+        if isinstance(clip, ClipGradByGlobalNorm):
             # each rank sees only slice grads: the squared norm must reduce
             # across the sharding group before clipping (ref stage-3 clip)
             optimizer._grad_clip = _ShardedClipGradByGlobalNorm(
-                optimizer._grad_clip.clip_norm, group)
+                clip.clip_norm, group)
+        elif isinstance(clip, ClipGradByNorm):
+            raise NotImplementedError(
+                "ClipGradByNorm under stage-3 would clip per-SLICE norms and "
+                "silently diverge from serial; use ClipGradByGlobalNorm")
         wrapped = GroupShardedStage3(model, optimizer, group=group,
                                      offload=offload)
         return wrapped, optimizer, scaler
@@ -266,32 +276,20 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
     return wrapped, sharded_opt, scaler
 
 
-class _ShardedClipGradByGlobalNorm:
-    """ClipGradByGlobalNorm over slice-sharded grads: local sum-of-squares is
+class _ShardedClipGradByGlobalNorm(_ClipGradByGlobalNorm):
+    """ClipGradByGlobalNorm over slice-sharded grads: the squared norm is
     all-reduced across the sharding group so every rank clips with the TRUE
-    global norm (ref group_sharded clip)."""
+    global norm (ref group_sharded clip).  Subclassing keeps _need_clip
+    semantics and isinstance checks (e.g. HybridParallelOptimizer's)."""
 
     def __init__(self, clip_norm, group=None):
-        self.clip_norm = float(clip_norm)
+        super().__init__(clip_norm)
         self._group = group
 
-    def __call__(self, params_grads):
-        sumsq = jnp.zeros((), jnp.float32)
-        for _p, g in params_grads:
-            if g is not None:
-                sumsq = sumsq + jnp.sum(jnp.square(g._data.astype(jnp.float32)))
-        t = Tensor(sumsq[None], stop_gradient=True)
+    def _reduce_global_norm_sq(self, global_norm):
+        t = Tensor(jnp.square(global_norm)[None], stop_gradient=True)
         all_reduce(t, ReduceOp.SUM, group=self._group)
-        norm = jnp.sqrt(t._data[0])
-        scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
-        out = []
-        for p, g in params_grads:
-            if g is None:
-                out.append((p, g))
-                continue
-            out.append((p, Tensor((g._data * scale).astype(g._data.dtype),
-                                  stop_gradient=True)))
-        return out
+        return jnp.sqrt(t._data[0])
 
 
 def save_group_sharded_model(model, output, optimizer=None):

@@ -1,0 +1,95 @@
+"""Eager tensor-parallel (mpu) trainer for the multi-process harness:
+Column->Row parallel MLP + VocabParallelEmbedding across 2 REAL processes must
+match the serial model (ref hybrid_parallel_mp_model.py test pattern)."""
+import json
+import os
+
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=1"
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.distributed.fleet as fleet
+
+
+D_IN, D_HID, VOCAB = 8, 16, 12
+
+
+def _full_weights():
+    rng = np.random.RandomState(42)
+    return {
+        "emb": rng.randn(VOCAB, D_IN).astype(np.float32) * 0.1,
+        "w1": rng.randn(D_IN, D_HID).astype(np.float32) * 0.1,
+        "b1": rng.randn(D_HID).astype(np.float32) * 0.1,
+        "w2": rng.randn(D_HID, D_IN).astype(np.float32) * 0.1,
+        "b2": rng.randn(D_IN).astype(np.float32) * 0.1,
+    }
+
+
+def serial_forward_backward(ids):
+    import jax.numpy as jnp
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    w = _full_weights()
+    emb = paddle.to_tensor(w["emb"])
+    emb.stop_gradient = False
+    x = F.embedding(paddle.to_tensor(ids), emb)
+    h = paddle.to_tensor(w["w1"])
+    h.stop_gradient = False
+    out = F.relu(paddle.matmul(x, h) + paddle.to_tensor(w["b1"]))
+    w2 = paddle.to_tensor(w["w2"])
+    out = paddle.matmul(out, w2) + paddle.to_tensor(w["b2"])
+    loss = (out * out).mean()
+    loss.backward()
+    return float(loss._data), np.asarray(emb.grad._data)
+
+
+def main():
+    import jax.numpy as jnp
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed.fleet.layers.mpu import (
+        ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+
+    env = dist.init_parallel_env()
+    world, rank = env.world_size, env.rank
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": world,
+                               "pp_degree": 1, "sharding_degree": 1,
+                               "sep_degree": 1, "mp_configs": {},
+                               "pp_configs": {}}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    w = _full_weights()
+    emb = VocabParallelEmbedding(VOCAB, D_IN)
+    col = ColumnParallelLinear(D_IN, D_HID, has_bias=True, gather_output=False)
+    row = RowParallelLinear(D_HID, D_IN, has_bias=True, input_is_parallel=True)
+    # load the SERIAL weights' shards
+    per_v = VOCAB // world
+    emb.weight.set_value(w["emb"][rank * per_v:(rank + 1) * per_v])
+    per_h = D_HID // world
+    col.weight.set_value(w["w1"][:, rank * per_h:(rank + 1) * per_h])
+    col.bias.set_value(w["b1"][rank * per_h:(rank + 1) * per_h])
+    row.weight.set_value(w["w2"][rank * per_h:(rank + 1) * per_h])
+    row.bias.set_value(w["b2"])
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, VOCAB, (4, 6)).astype(np.int32)
+    x = emb(paddle.to_tensor(ids))
+    h = F.relu(col(x))
+    out = row(h)
+    loss = (out * out).mean()
+    loss.backward()
+    # embedding grad shard must equal the serial grad's shard
+    serial_loss, serial_emb_grad = serial_forward_backward(ids)
+    my_grad = np.asarray(emb.weight.grad._data)
+    expect = serial_emb_grad[rank * per_v:(rank + 1) * per_v]
+    ok_grad = bool(np.allclose(my_grad, expect, rtol=1e-4, atol=1e-5))
+    print("TPRESULT " + json.dumps(
+        {"rank": rank, "loss": float(loss._data), "serial_loss": serial_loss,
+         "grad_ok": ok_grad}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

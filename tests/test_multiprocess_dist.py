@@ -106,3 +106,17 @@ def test_group_sharded_stage3_parity_and_memory(tmp_path, offload):
         np.testing.assert_allclose(r["param_sum"], serial_ps, rtol=1e-4)
         # resident bytes shrink ~2x (padding allows slack)
         assert r["resident_bytes"] < 0.75 * r["full_bytes"]
+
+
+def test_tensor_parallel_mpu_across_processes(tmp_path):
+    """Eager TP (VocabParallelEmbedding + Column/RowParallelLinear) across 2
+    real processes: loss and grad shards match the serial model (ref
+    hybrid_parallel_mp_model.py)."""
+    proc, logdict = _launch("tp_parity.py", 2, tmp_path)
+    logs = "\n".join(logdict.values())
+    assert proc.returncode == 0, f"rc={proc.returncode}\n{proc.stdout}\n{logs}"
+    results = [json.loads(m) for m in re.findall(r"TPRESULT (.*)", logs)]
+    assert len(results) == 2, logs
+    for r in results:
+        np.testing.assert_allclose(r["loss"], r["serial_loss"], rtol=1e-4)
+        assert r["grad_ok"], r
