@@ -374,6 +374,9 @@ def _pp_loss(params, tokens, labels, config, cfg: MeshConfig, mesh):
     M = cfg.micro_batches
     Ppp = cfg.pp
     B, S = tokens.shape
+    assert B % M == 0, \
+        f"batch {B} must divide into micro_batches={M} (pad the batch; " \
+        "uneven microbatches are not supported)"
     mb = B // M
     D = config.hidden_size
     # MoE with ep runs in the SAME manual region as pp (shardy requires manual
