@@ -306,6 +306,12 @@ def _vp_ce(h, head, labels, mesh, cfg: MeshConfig):
             else:
                 manual.discard("pp")
                 batch_axes = ()
+    # cp shards the SEQUENCE dim; like ep, leaving it auto crashes the gather
+    # partitioner when another manual axis is live
+    seq_axes = ()
+    if cfg.cp > 1 and "pp" in manual and h.shape[1] % cfg.cp == 0:
+        manual.add("cp")
+        seq_axes = ("cp",)
     if cfg.mp > 1:
         manual.add("mp")
     if not manual:
@@ -339,12 +345,13 @@ def _vp_ce(h, head, labels, mesh, cfg: MeshConfig):
         mask = (lab_l >= 0).astype(jnp.float32)
         ls = jnp.sum((lse - pick) * mask)
         n = jnp.sum(mask)
-        if batch_axes:
-            ls = jax.lax.psum(ls, batch_axes)
-            n = jax.lax.psum(n, batch_axes)
+        if batch_axes or seq_axes:
+            ls = jax.lax.psum(ls, batch_axes + seq_axes)
+            n = jax.lax.psum(n, batch_axes + seq_axes)
         return ls, n
 
-    spec_b = P(batch_axes) if batch_axes else P()
+    spec_b = P(batch_axes if batch_axes else None,
+               seq_axes if seq_axes else None)
     spec_head = P(None, "mp") if have_mp else P()
     ls, n = jax.shard_map(local, mesh=mesh, axis_names=manual,
                           in_specs=(spec_b, spec_head, spec_b),
@@ -383,10 +390,16 @@ def _pp_loss(params, tokens, labels, config, cfg: MeshConfig, mesh):
     # axes to be declared together rather than nested), so each (pp, ep) rank
     # routes its microbatch shard and all_to_all's over 'ep' inside the tick
     moe_manual = config.moe_num_experts > 0 and cfg.ep > 1
-    manual = ("pp", "ep") if moe_manual else ("pp",)
+    cp_manual = cfg.cp > 1
+    manual = ("pp",) + (("ep",) if moe_manual else ()) + \
+        (("cp",) if cp_manual else ())
     if moe_manual:
         assert mb % cfg.ep == 0, f"microbatch {mb} must divide over ep={cfg.ep}"
+    if cp_manual:
+        assert not moe_manual, "cp x ep is not supported yet"
+        assert S % cfg.cp == 0, f"seq len {S} must divide over cp={cfg.cp}"
     mb_l = mb // cfg.ep if moe_manual else mb
+    S_l = S // cfg.cp if cp_manual else S
     moe_impl = (lambda bpl, xl, c: _moe_local(bpl, xl, c, cfg.ep)) \
         if moe_manual else None
 
@@ -411,8 +424,15 @@ def _pp_loss(params, tokens, labels, config, cfg: MeshConfig, mesh):
         blocks_arg = params["blocks"]
         T = M + Ppp - 1
 
+    attn_impl = None
+    if cp_manual:
+        from .ring_attention import ring_attention_local
+        attn_impl = functools.partial(ring_attention_local, axis_name="cp",
+                                      cp=cfg.cp, causal=True)
+
     def local_fn(blocks_local, xs_rep):
         p = jax.lax.axis_index("pp")
+        pos_offset = jax.lax.axis_index("cp") * S_l if cp_manual else None
 
         def tick(carry, t):
             buf, aux_acc = carry
@@ -433,13 +453,16 @@ def _pp_loss(params, tokens, labels, config, cfg: MeshConfig, mesh):
                 valid = (t >= p) & (t < p + M)
             inp = jnp.where(inject, xs_rep[m], buf)
             out, aux = gpt_mod.run_blocks(chunk, inp, config,
-                                          remat=cfg.remat, moe_impl=moe_impl)
+                                          remat=cfg.remat, moe_impl=moe_impl,
+                                          attn_impl=attn_impl,
+                                          pos_offset=pos_offset)
             nxt = jax.lax.ppermute(out, "pp",
                                    [(i, (i + 1) % Ppp) for i in range(Ppp)])
             # invalid (warmup/cooldown) ticks run on garbage; mask their aux
             return (nxt, aux_acc + aux * valid.astype(aux.dtype)), out
 
-        buf0 = gpt_mod.pvary_compat(jnp.zeros((mb_l, S, D), xs_rep.dtype), manual)
+        buf0 = gpt_mod.pvary_compat(jnp.zeros((mb_l, S_l, D), xs_rep.dtype),
+                                    manual)
         aux0 = gpt_mod.pvary_compat(jnp.zeros((), jnp.float32), manual)
         (_, aux_sum), outs = jax.lax.scan(tick, (buf0, aux0), jnp.arange(T))
         # drop warmup/cooldown garbage IN-shard: only M ticks (and their grad
@@ -464,10 +487,14 @@ def _pp_loss(params, tokens, labels, config, cfg: MeshConfig, mesh):
         blk_in = {k: (P("pp", "ep") if (moe_manual and k in _MOE_EXPERT_KEYS)
                       else P("pp"))
                   for k in params["blocks"]}
+    xs_spec = P(None, "ep" if moe_manual else None,
+                "cp" if cp_manual else None)
+    out_spec = P("pp", "ep" if moe_manual else None,
+                 "cp" if cp_manual else None)
     f = jax.shard_map(
         local_fn, mesh=mesh, axis_names=set(manual),
-        in_specs=(blk_in, P(None, "ep") if moe_manual else P()),
-        out_specs=(P("pp", "ep") if moe_manual else P("pp"), P()))
+        in_specs=(blk_in, xs_spec),
+        out_specs=(out_spec, P()))
     stacked_all, aux_sum = f(blocks_arg, xs)   # [Ppp*M, mb, S, D]
     if moe_manual:
         aux_sum = aux_sum / cfg.ep
@@ -479,8 +506,9 @@ def _pp_loss(params, tokens, labels, config, cfg: MeshConfig, mesh):
     head = params["wte"].T if config.tie_word_embeddings else params["lm_head"]
     loss = _vp_ce(h, head, labels, mesh, cfg)
     if config.moe_num_experts > 0:
-        # aux_sum covers all M microbatches; average to match the dense scale
-        loss = loss + config.moe_aux_weight * aux_sum / M
+        # aux_sum covers all M microbatches (and, with cp, all cp seq shards);
+        # average to match the dense scale
+        loss = loss + config.moe_aux_weight * aux_sum / (M * cfg.cp)
     return loss
 
 
@@ -566,9 +594,7 @@ class HybridParallelTrainer:
             moe_impl = functools.partial(_moe_ffn_ep, cfg=cfg, mesh=mesh)
 
         if cfg.cp > 1:
-            assert cfg.pp == 1 and cfg.ep == 1, \
-                "cp composes with dp/sharding/mp; cp x pp / cp x ep are not " \
-                "supported yet"
+            assert cfg.ep == 1, "cp x ep is not supported yet"
         if cfg.vpp > 1:
             assert cfg.pp > 1, \
                 "vpp (interleaved virtual stages) requires pp > 1 (ref: " \

@@ -143,3 +143,23 @@ def test_flash_attention_segment_ids_api():
                                   jnp.asarray(x), jnp.asarray(seg),
                                   jnp.asarray(seg), True, D ** -0.5)
     np.testing.assert_allclose(out.numpy(), np.asarray(ref), atol=1e-5)
+
+
+def test_cp_composes_with_pipeline():
+    """cp folded into the pp manual region: ring attention inside pipeline
+    ticks, per-shard RoPE offsets, CE folds cp into its manual seq axes."""
+    from paddle_tpu.models.gpt import GPTConfig
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=4, num_heads=4,
+                    max_seq_len=128)
+    rng = np.random.RandomState(0)
+    tok = rng.randint(0, cfg.vocab_size, (4, 128)).astype(np.int32)
+    lab = np.roll(tok, -1, 1).astype(np.int32)
+    ref = HybridParallelTrainer(cfg, MeshConfig(), seed=3,
+                                devices=jax.devices()[:1])
+    rl = [float(ref.train_step(tok, lab)) for _ in range(3)]
+    for mc, n in ((MeshConfig(pp=2, cp=2, micro_batches=2), 4),
+                  (MeshConfig(dp=2, pp=2, cp=2, micro_batches=2, remat=True), 8),
+                  (MeshConfig(pp=2, cp=2, vpp=2, micro_batches=2), 4)):
+        t = HybridParallelTrainer(cfg, mc, seed=3, devices=jax.devices()[:n])
+        cl = [float(t.train_step(tok, lab)) for _ in range(3)]
+        np.testing.assert_allclose(cl, rl, rtol=1e-4)
