@@ -146,6 +146,13 @@ def register_post_backward_callback(cb):
     return cb
 
 
+def unregister_post_backward_callback(cb):
+    try:
+        _post_backward_callbacks.remove(cb)
+    except ValueError:
+        pass
+
+
 def run_backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
                  retain_graph: bool = False) -> None:
     """Full backward from seeds, accumulating into leaf `.grad` (`RunBackward` parity)."""

@@ -45,11 +45,18 @@ def save_state_dict(state: Dict[str, Any], path: str) -> None:
     flat = jax.tree_util.tree_flatten_with_path(state)[0]
     os.makedirs(path, exist_ok=True)
     arrays = {}
-    meta = {"leaves": {}, "mesh": None}
+    meta = {"leaves": {}, "mesh": None, "dtypes": {}}
     for keypath, leaf in flat:
         name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
                         for k in keypath)
-        arrays[name] = np.asarray(leaf)      # gathers shards over ICI
+        a = np.asarray(leaf)                 # gathers shards over ICI
+        # npz round-trips ml_dtypes (bfloat16, float8_*) as raw void — record
+        # the dtype name and store a same-width uint bit-view instead (the
+        # reference dist_saver preserves dtype in its metadata the same way)
+        if a.dtype.kind == "V" or a.dtype.name not in np.sctypeDict:
+            meta["dtypes"][name] = a.dtype.name
+            a = a.view(np.dtype(f"u{a.dtype.itemsize}"))
+        arrays[name] = a
         sh = getattr(leaf, "sharding", None)
         meta["leaves"][name] = _spec_to_meta(sh)
         if isinstance(sh, NamedSharding) and meta["mesh"] is None:
@@ -69,6 +76,11 @@ def load_state_dict(path: str, target_shardings=None, template=None):
     The target mesh may differ arbitrarily from the saving mesh — this is the
     reference converter's cross-mesh resume."""
     data = np.load(os.path.join(path, "data.npz"))
+    try:
+        with open(os.path.join(path, "dist_attr.json")) as f:
+            saved_dtypes = json.load(f).get("dtypes", {})
+    except FileNotFoundError:
+        saved_dtypes = {}
     with open(os.path.join(path, "treedef.pkl"), "rb") as f:
         treedef = pickle.load(f)
     # rebuild leaves in treedef order
@@ -82,7 +94,14 @@ def load_state_dict(path: str, target_shardings=None, template=None):
                         for k in keypath)
         order[idx] = name
         names.append(name)
-    leaves = [data[n] for n in order]
+    def _restore(n):
+        a = data[n]
+        if n in saved_dtypes:
+            import ml_dtypes
+            a = a.view(np.dtype(getattr(ml_dtypes, saved_dtypes[n])))
+        return a
+
+    leaves = [_restore(n) for n in order]
     state = jax.tree_util.tree_unflatten(treedef, leaves)
     if target_shardings is not None:
         state = jax.tree_util.tree_map(

@@ -51,9 +51,10 @@ class DataParallel(Layer):
         fused flat allreduce runs and the averaged slices are scattered back."""
         if self._world <= 1:
             return
+        import weakref
         world = self._world
         group = self.group
-        dp = self
+        dp_ref = weakref.ref(self)
         params = [p for p in self._layers.parameters() if not p.stop_gradient]
         self._bucket = []           # [(param, local partial-grad data)]
         self._bucket_bytes = 0
@@ -67,8 +68,13 @@ class DataParallel(Layer):
             accumulated into .grad by the engine, so they are corrected with
             += (avg - local) — which also preserves grads accumulated under
             no_sync.  The current param's averaged partial is returned for the
-            engine's own accumulation."""
-            if not dp._bucket:
+            engine's own accumulation.
+
+            Resolves the wrapper through the weakref so nothing reachable from
+            the global callback registry or the param hooks strongly holds the
+            wrapper — a dropped DataParallel frees by refcount alone."""
+            dp = dp_ref()
+            if dp is None or not dp._bucket:
                 return None
             entries = dp._bucket
             dp._bucket = []
@@ -95,20 +101,38 @@ class DataParallel(Layer):
         self._flush_bucket = flush
         # the remainder bucket flushes when the ENGINE reports the backward
         # finished — hook-fire counting cannot detect completion (shared
-        # params fire per consumer edge, unused params never fire)
+        # params fire per consumer edge, unused params never fire).  The global
+        # callback holds only a weakref so a dead wrapper auto-deregisters
+        # instead of leaking the model and flushing stale buckets forever.
         from ..core import autograd as _ag
-        _ag.register_post_backward_callback(lambda: flush(None))
+
+        def _post_backward_flush():
+            live = dp_ref()
+            if live is None:
+                _ag.unregister_post_backward_callback(_post_backward_flush)
+                return
+            live._flush_bucket(None)
+
+        _ag.register_post_backward_callback(_post_backward_flush)
+        self._post_backward_cb = _post_backward_flush
 
         for p in params:
             def hook(grad, _p=p):
-                if not dp._enable_sync:
+                live = dp_ref()
+                if live is None or not live._enable_sync:
                     return grad
-                dp._bucket.append((_p, grad._data))
-                dp._bucket_bytes += grad._data.size * grad._data.dtype.itemsize
-                if dp._bucket_bytes >= cap:
-                    return flush(_p)
+                live._bucket.append((_p, grad._data))
+                live._bucket_bytes += grad._data.size * grad._data.dtype.itemsize
+                if live._bucket_bytes >= cap:
+                    return live._flush_bucket(_p)
                 return grad
             p.register_hook(hook)
+
+    def __del__(self):
+        cb = getattr(self, "_post_backward_cb", None)
+        if cb is not None:
+            from ..core import autograd as _ag
+            _ag.unregister_post_backward_callback(cb)
 
     @contextlib.contextmanager
     def no_sync(self):

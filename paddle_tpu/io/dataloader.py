@@ -72,6 +72,37 @@ def _to_tensors(batch, places=None):
     return Tensor(np.asarray(batch))
 
 
+def _mp_worker_main(wid, num_workers, dataset, collate_fn, worker_init_fn,
+                    ring_name, assigned):
+    """Spawned worker entry: build assigned batches, push through the shm ring.
+
+    Module-level (not a bound method) so only these picklable fields cross the
+    spawn boundary — an unpicklable places/batch_sampler on the DataLoader
+    itself must not reach Process.start()."""
+    from .shm_ring import ShmRing
+    global _worker_info
+    _worker_info = WorkerInfo(wid, num_workers, dataset)
+    ring = None
+    try:
+        ring = ShmRing(ring_name, create=False)
+        if worker_init_fn:
+            worker_init_fn(wid)
+        for indices in assigned:
+            batch = [dataset[i] for i in indices]
+            ring.put(collate_fn(batch))
+    except BaseException:
+        if ring is not None:
+            try:
+                ring.put({"__dataloader_worker_error__":
+                          traceback.format_exc()})
+            except Exception:
+                pass
+    finally:
+        if ring is not None:
+            ring.close_producer()
+        os._exit(0)
+
+
 class DataLoader:
     def __init__(self, dataset, feed_list=None, places=None, return_list=True,
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
@@ -165,33 +196,6 @@ class DataLoader:
         if err:
             raise err[0]
 
-    # ---- true multiprocess workers over C++ shm rings ----
-    def _worker_loop(self, wid, ring_name, assigned):
-        """Runs in the spawned worker process: build assigned batches, push
-        through this worker's shm ring."""
-        from .shm_ring import ShmRing
-        global _worker_info
-        _worker_info = WorkerInfo(wid, self.num_workers, self.dataset)
-        ring = None
-        try:
-            ring = ShmRing(ring_name, create=False)
-            if self.worker_init_fn:
-                self.worker_init_fn(wid)
-            for indices in assigned:
-                batch = [self.dataset[i] for i in indices]
-                ring.put(self.collate_fn(batch))
-        except BaseException:
-            if ring is not None:
-                try:
-                    ring.put({"__dataloader_worker_error__":
-                              traceback.format_exc()})
-                except Exception:
-                    pass
-        finally:
-            if ring is not None:
-                ring.close_producer()
-            os._exit(0)
-
     _ring_counter = itertools.count()
 
     def _iter_multiprocess(self):
@@ -211,8 +215,11 @@ class DataLoader:
         try:
             for w in range(nw):
                 assigned = batches[w::nw]
-                p = ctx.Process(target=self._worker_loop,
-                                args=(w, rings[w].name, assigned), daemon=True)
+                p = ctx.Process(target=_mp_worker_main,
+                                args=(w, nw, self.dataset, self.collate_fn,
+                                      self.worker_init_fn, rings[w].name,
+                                      assigned),
+                                daemon=True)
                 p.start()
                 procs.append(p)
             timeout_ms = int(self.timeout * 1000) if self.timeout else -1
@@ -248,6 +255,8 @@ class DataLoader:
                 r.free()
 
     def _picklable_for_workers(self):
+        # must mirror the exact _mp_worker_main payload: nothing else of the
+        # DataLoader crosses the spawn boundary
         import pickle as _pickle
         try:
             _pickle.dumps((self.dataset, self.collate_fn,

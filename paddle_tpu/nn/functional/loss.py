@@ -387,8 +387,30 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None, path_table=None,
 
     Heap layout: internal nodes are ids [0, C-2], leaves [C-1, 2C-2] (exactly
     C-1 internal nodes for ANY class count); class c's path walks parents from
-    leaf id c + C - 1 to the root, so leaf probabilities sum to 1."""
+    leaf id c + C - 1 to the root, so leaf probabilities sum to 1.
+
+    Custom tree: path_table/path_code [N, L] give each sample's node ids and
+    left/right codes from leaf to root (-1 padded); each step is a binary
+    cross-entropy with the code as the label (ref loss.py:916-924)."""
     import math as _m
+    if (path_table is None) != (path_code is None):
+        raise ValueError("path_table and path_code must be given together")
+    if path_table is not None:
+        def fc(x, pt, pc, w, *b):
+            nodes = pt.astype(jnp.int32).reshape(x.shape[0], -1)   # [N, L]
+            codes = pc.astype(jnp.int32).reshape(x.shape[0], -1)
+            valid = nodes >= 0
+            safe = jnp.maximum(nodes, 0)
+            logits = jnp.einsum("nld,nd->nl", w[safe], x)
+            if b:
+                logits = logits + b[0].reshape(-1)[safe]
+            # BCE(sigmoid(z), c) = softplus(z) - c*z = softplus((1-2c)*z)
+            z = jnp.where(codes > 0, -logits, logits)
+            return jnp.mean(jnp.sum(jnp.where(valid, jax.nn.softplus(z), 0.0),
+                                    axis=1))
+        args = (input, path_table, path_code, weight) + \
+            ((bias,) if bias is not None else ())
+        return apply("hsigmoid_loss", fc, *args)
     C = int(num_classes)
     depth = max(int(_m.ceil(_m.log2(max(C, 2)))) + 1, 1)
 
@@ -417,6 +439,15 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
                          reduction="mean", name=None):
     """ref loss.py margin_cross_entropy (ArcFace/CosFace family margins):
     cos(m1*theta + m2) - m3 applied to the target logit, then scaled CE."""
+    # group=False is the documented "no parallelism" value (ref loss.py) — the
+    # local computation below is exactly right for it; a real group means
+    # vocab-sharded logits needing a distributed softmax, which a local-only
+    # CE would get silently wrong
+    if group not in (None, False):
+        raise NotImplementedError(
+            "margin_cross_entropy(group=...) (model-parallel sharded logits) "
+            "is not supported; gather logits or use the compiled trainer's "
+            "vocab-parallel CE (paddle_tpu/parallel/hybrid.py _vp_ce)")
     def f(lg, y):
         yi = y.astype(jnp.int32).reshape(-1)
         n = lg.shape[0]
