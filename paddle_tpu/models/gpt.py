@@ -29,6 +29,13 @@ from ..incubate.kernels.rope import apply_rope
 
 @dataclasses.dataclass
 class GPTConfig:
+    """One transformer-family config covering GPT / LLaMA / BERT architectures.
+
+    The reference implements these as separate model zoos (PaddleNLP gpt/llama/
+    bert); TPU-first we keep ONE stacked-block functional core and express the
+    family differences as config axes — every member then rides the same
+    compiled hybrid-parallel trainer unchanged.
+    """
     vocab_size: int = 50304
     hidden_size: int = 2048
     num_layers: int = 24
@@ -41,6 +48,16 @@ class GPTConfig:
     initializer_range: float = 0.02
     tie_word_embeddings: bool = True
     dtype: Any = jnp.float32
+    # --- architecture axes beyond GPT ---
+    num_kv_heads: Optional[int] = None  # GQA (llama-2/3): kv heads < q heads
+    gated_ffn: bool = False     # SwiGLU: down(act(gate(x)) * up(x))
+    use_bias: bool = True       # llama drops all linear biases
+    causal: bool = True         # False = bidirectional encoder (BERT)
+    norm_position: str = "pre"  # "post" = BERT-style residual-then-norm
+    embed_norm: bool = False    # BERT: LayerNorm right after the embeddings
+    final_norm: bool = True     # BERT (post-LN) has no final encoder norm
+    type_vocab_size: int = 0    # BERT segment (token-type) embeddings
+    mlm_head: bool = False      # BERT MLM transform (dense+act+LN) before head
     # MoE (ref incubate/distributed/models/moe): >0 replaces the dense FFN with
     # moe_num_experts capacity-routed experts in every block
     moe_num_experts: int = 0
@@ -55,6 +72,15 @@ class GPTConfig:
     @property
     def head_dim(self):
         return self.hidden_size // self.num_heads
+
+    @property
+    def kv_heads(self):
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def qkv_dim(self):
+        """Packed q|k|v output width: D + 2 * kv_heads * head_dim."""
+        return self.hidden_size + 2 * self.kv_heads * self.head_dim
 
 
 def gpt3_1p3b():
@@ -81,7 +107,7 @@ def gpt_moe_tiny(seq_len=128, num_experts=4, capacity_factor=2.0):
 def init_params(config: GPTConfig, key) -> Dict[str, Any]:
     c = config
     D, L, F, V = c.hidden_size, c.num_layers, c.ffn_size, c.vocab_size
-    k = iter(jax.random.split(key, 16))
+    k = iter(jax.random.split(key, 24))
     std = c.initializer_range
     proj_std = std / math.sqrt(2 * L)  # GPT-2/3 residual-scaled init
 
@@ -93,12 +119,13 @@ def init_params(config: GPTConfig, key) -> Dict[str, Any]:
     lnf_w, lnf_b = norm_pair((D,))
     blocks = {
         "ln1_w": ln1_w, "ln1_b": ln1_b,
-        "qkv_w": (jax.random.normal(next(k), (L, D, 3 * D)) * std).astype(c.dtype),
-        "qkv_b": jnp.zeros((L, 3 * D), c.dtype),
+        "qkv_w": (jax.random.normal(next(k), (L, D, c.qkv_dim)) * std).astype(c.dtype),
         "proj_w": (jax.random.normal(next(k), (L, D, D)) * proj_std).astype(c.dtype),
-        "proj_b": jnp.zeros((L, D), c.dtype),
         "ln2_w": ln2_w, "ln2_b": ln2_b,
     }
+    if c.use_bias:
+        blocks["qkv_b"] = jnp.zeros((L, c.qkv_dim), c.dtype)
+        blocks["proj_b"] = jnp.zeros((L, D), c.dtype)
     if c.moe_num_experts > 0:
         E = c.moe_num_experts
         blocks.update({
@@ -111,17 +138,32 @@ def init_params(config: GPTConfig, key) -> Dict[str, Any]:
     else:
         blocks.update({
             "fc1_w": (jax.random.normal(next(k), (L, D, F)) * std).astype(c.dtype),
-            "fc1_b": jnp.zeros((L, F), c.dtype),
             "fc2_w": (jax.random.normal(next(k), (L, F, D)) * proj_std).astype(c.dtype),
-            "fc2_b": jnp.zeros((L, D), c.dtype),
         })
+        if c.gated_ffn:
+            blocks["fcg_w"] = (jax.random.normal(next(k), (L, D, F)) * std).astype(c.dtype)
+        if c.use_bias:
+            blocks["fc1_b"] = jnp.zeros((L, F), c.dtype)
+            blocks["fc2_b"] = jnp.zeros((L, D), c.dtype)
+            if c.gated_ffn:
+                blocks["fcg_b"] = jnp.zeros((L, F), c.dtype)
     params = {
         "wte": (jax.random.normal(next(k), (V, D)) * std).astype(c.dtype),
         "blocks": blocks,
-        "lnf_w": lnf_w, "lnf_b": lnf_b,
     }
+    if c.final_norm or c.embed_norm:
+        # post-LN encoders (BERT) reuse the lnf pair as the EMBEDDING norm
+        params["lnf_w"], params["lnf_b"] = lnf_w, lnf_b
     if not c.use_rope:
         params["wpe"] = (jax.random.normal(next(k), (c.max_seq_len, D)) * std).astype(c.dtype)
+    if c.type_vocab_size > 0:
+        params["tte"] = (jax.random.normal(next(k), (c.type_vocab_size, D))
+                         * std).astype(c.dtype)
+    if c.mlm_head:
+        params["mlm_w"] = (jax.random.normal(next(k), (D, D)) * std).astype(c.dtype)
+        params["mlm_b"] = jnp.zeros((D,), c.dtype)
+        params["mlm_ln_w"] = jnp.ones((D,), c.dtype)
+        params["mlm_ln_b"] = jnp.zeros((D,), c.dtype)
     if not c.tie_word_embeddings:
         params["lm_head"] = (jax.random.normal(next(k), (D, V)) * std).astype(c.dtype)
     return params
@@ -169,20 +211,28 @@ def block_forward(bp, x, config: GPTConfig, mp_constraint=None, moe_impl=None,
     """
     c = config
     B, S, D = x.shape
-    H, hd = c.num_heads, c.head_dim
+    H, KVH, hd = c.num_heads, c.kv_heads, c.head_dim
+    pre = c.norm_position == "pre"
 
-    h = _norm(x, bp["ln1_w"], bp["ln1_b"], c)
-    qkv = jnp.matmul(h, bp["qkv_w"]) + bp["qkv_b"]
+    h = _norm(x, bp["ln1_w"], bp["ln1_b"], c) if pre else x
+    qkv = jnp.matmul(h, bp["qkv_w"])
+    if "qkv_b" in bp:
+        qkv = qkv + bp["qkv_b"]
     if mp_constraint:
         qkv = mp_constraint(qkv, "hidden_mp")
-    q, kk, v = jnp.split(qkv, 3, axis=-1)
+    q, kk, v = jnp.split(qkv, [H * hd, (H + KVH) * hd], axis=-1)
     q = q.reshape(B, S, H, hd)
-    kk = kk.reshape(B, S, H, hd)
-    v = v.reshape(B, S, H, hd)
+    kk = kk.reshape(B, S, KVH, hd)
+    v = v.reshape(B, S, KVH, hd)
     if c.use_rope:
         sin, cos = _rope_tables(c, S, pos_offset)
         q = apply_rope(q, sin, cos)
         kk = apply_rope(kk, sin, cos)
+    if KVH != H:
+        # GQA: each kv head serves H/KVH query heads (ref llama GQA repeat);
+        # materializing the repeat keeps the flash kernel's H-uniform layout
+        kk = jnp.repeat(kk, H // KVH, axis=2)
+        v = jnp.repeat(v, H // KVH, axis=2)
     # saved under remat_policy_save_attention: the block replay then DCEs the qkv
     # matmul + rope (their only consumers' values are saved), keeping replay to
     # the proj/mlp chain
@@ -192,23 +242,47 @@ def block_forward(bp, x, config: GPTConfig, mp_constraint=None, moe_impl=None,
     if attn_impl is not None:
         attn = attn_impl(q, kk, v)
     else:
-        attn = flash_attention_fused(q, kk, v, causal=True)
+        attn = flash_attention_fused(q, kk, v, causal=c.causal)
     attn = attn.reshape(B, S, D)
-    attn = jnp.matmul(attn, bp["proj_w"]) + bp["proj_b"]
+    attn = jnp.matmul(attn, bp["proj_w"])
+    if "proj_b" in bp:
+        attn = attn + bp["proj_b"]
     x = x + attn
+    if not pre:
+        x = _norm(x, bp["ln1_w"], bp["ln1_b"], c)
 
-    h = _norm(x, bp["ln2_w"], bp["ln2_b"], c)
+    h = _norm(x, bp["ln2_w"], bp["ln2_b"], c) if pre else x
     if c.moe_num_experts > 0:
         from ..incubate.distributed.models.moe.dispatch import moe_ffn_dense
         fn = moe_impl or moe_ffn_dense
         y, aux = fn(bp, h.reshape(B * S, D), c)
-        return x + y.reshape(B, S, D), aux
-    h = jnp.matmul(h, bp["fc1_w"]) + bp["fc1_b"]
-    if mp_constraint:
-        h = mp_constraint(h, "ffn_mp")
-    h = jax.nn.gelu(h) if c.activation == "gelu" else jax.nn.silu(h)
-    h = jnp.matmul(h, bp["fc2_w"]) + bp["fc2_b"]
-    return x + h, jnp.zeros((), jnp.float32)
+        x = x + y.reshape(B, S, D)
+        if not pre:
+            x = _norm(x, bp["ln2_w"], bp["ln2_b"], c)
+        return x, aux
+    up = jnp.matmul(h, bp["fc1_w"])
+    if "fc1_b" in bp:
+        up = up + bp["fc1_b"]
+    act = jax.nn.gelu if c.activation == "gelu" else jax.nn.silu
+    if c.gated_ffn:
+        gate = jnp.matmul(h, bp["fcg_w"])
+        if "fcg_b" in bp:
+            gate = gate + bp["fcg_b"]
+        if mp_constraint:
+            up = mp_constraint(up, "ffn_mp")
+            gate = mp_constraint(gate, "ffn_mp")
+        h = act(gate) * up
+    else:
+        if mp_constraint:
+            up = mp_constraint(up, "ffn_mp")
+        h = act(up)
+    h = jnp.matmul(h, bp["fc2_w"])
+    if "fc2_b" in bp:
+        h = h + bp["fc2_b"]
+    x = x + h
+    if not pre:
+        x = _norm(x, bp["ln2_w"], bp["ln2_b"], c)
+    return x, jnp.zeros((), jnp.float32)
 
 
 def run_blocks(blocks, x, config, mp_constraint=None, remat=False, moe_impl=None,
@@ -243,20 +317,50 @@ def run_blocks(blocks, x, config, mp_constraint=None, remat=False, moe_impl=None
     return out, aux
 
 
+def embed_prologue(params, x, config: GPTConfig, type_ids=None):
+    """Everything between the token-table lookup and the first block:
+    learned positions, segment (token-type) embeddings, embedding norm.
+    type_ids default to segment 0 (single-sentence BERT batches)."""
+    S = x.shape[1]
+    if not config.use_rope:
+        x = x + params["wpe"][:S]
+    if config.type_vocab_size > 0:
+        if type_ids is None:
+            x = x + params["tte"][0]
+        else:
+            x = x + jnp.take(params["tte"], type_ids, axis=0)
+    if config.embed_norm:
+        x = _norm(x, params["lnf_w"], params["lnf_b"], config)
+    return x
+
+
+def epilogue(params, h, config: GPTConfig):
+    """Final norm (pre-LN stacks) and/or the BERT MLM transform
+    (dense + act + LN, ref BertPretrainingHeads) before the vocab head."""
+    if config.final_norm:
+        h = _norm(h, params["lnf_w"], params["lnf_b"], config)
+    if config.mlm_head:
+        h = jnp.matmul(h, params["mlm_w"]) + params["mlm_b"]
+        h = jax.nn.gelu(h) if config.activation == "gelu" else jax.nn.silu(h)
+        h = _norm(h, params["mlm_ln_w"], params["mlm_ln_b"], config)
+    return h
+
+
+def head_matrix(params, config: GPTConfig):
+    return params["wte"].T if config.tie_word_embeddings else params["lm_head"]
+
+
 def backbone(params, tokens, config: GPTConfig, mp_constraint=None, remat=False,
-             moe_impl=None):
+             moe_impl=None, type_ids=None):
     """Shared trunk: tokens [B, S] -> (activations [B, S, D], head, moe aux)."""
     x = jnp.take(params["wte"], tokens, axis=0)
-    if not config.use_rope:
-        S = tokens.shape[1]
-        x = x + params["wpe"][:S]
+    x = embed_prologue(params, x, config, type_ids)
     if mp_constraint:
         x = mp_constraint(x, "act")
     x, aux = run_blocks(params["blocks"], x, config, mp_constraint, remat=remat,
                         moe_impl=moe_impl)
-    x = _norm(x, params["lnf_w"], params["lnf_b"], config)
-    head = params["wte"].T if config.tie_word_embeddings else params["lm_head"]
-    return x, head, aux
+    x = epilogue(params, x, config)
+    return x, head_matrix(params, config), aux
 
 
 def forward(params, tokens, config: GPTConfig, mp_constraint=None, remat=False):
@@ -370,9 +474,11 @@ class GPTForCausalLM(Layer):
 
 
 def llama_tiny(seq_len=128):
-    """Llama-architecture preset (RMSNorm + SiLU + untied head)."""
+    """Llama-architecture preset: RMSNorm + SwiGLU + GQA + no biases +
+    untied head — the full architecture family, scaled tiny."""
     return GPTConfig(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
-                     max_seq_len=seq_len, use_rms_norm=True, activation="silu",
+                     num_kv_heads=2, max_seq_len=seq_len, use_rms_norm=True,
+                     activation="silu", gated_ffn=True, use_bias=False,
                      tie_word_embeddings=False, intermediate_size=172)
 
 
@@ -380,8 +486,41 @@ def llama2_7b():
     """Llama-2 7B shape family (ref PaddleNLP llama configs)."""
     return GPTConfig(vocab_size=32000, hidden_size=4096, num_layers=32,
                      num_heads=32, max_seq_len=4096, use_rms_norm=True,
-                     activation="silu", tie_word_embeddings=False,
-                     intermediate_size=11008)
+                     activation="silu", gated_ffn=True, use_bias=False,
+                     tie_word_embeddings=False, intermediate_size=11008)
+
+
+def llama3_8b():
+    """Llama-3 8B shape family: GQA with 8 kv heads."""
+    return GPTConfig(vocab_size=128256, hidden_size=4096, num_layers=32,
+                     num_heads=32, num_kv_heads=8, max_seq_len=8192,
+                     use_rms_norm=True, activation="silu", gated_ffn=True,
+                     use_bias=False, tie_word_embeddings=False,
+                     intermediate_size=14336)
+
+
+def bert_config(vocab_size=30522, hidden_size=768, num_layers=12, num_heads=12,
+                max_seq_len=512, type_vocab_size=2, intermediate_size=None):
+    """BERT-architecture config (ref PaddleNLP bert): bidirectional post-LN
+    encoder, learned positions, segment embeddings, embedding LayerNorm, MLM
+    transform head tied to the embeddings.  NSP is intentionally dropped
+    (modern MLM-only pretraining; RoBERTa recipe)."""
+    return GPTConfig(vocab_size=vocab_size, hidden_size=hidden_size,
+                     num_layers=num_layers, num_heads=num_heads,
+                     max_seq_len=max_seq_len, use_rope=False, causal=False,
+                     norm_position="post", embed_norm=True, final_norm=False,
+                     type_vocab_size=type_vocab_size, mlm_head=True,
+                     intermediate_size=intermediate_size)
+
+
+def bert_base():
+    """BERT-base (baseline ladder #3)."""
+    return bert_config()
+
+
+def bert_tiny(seq_len=128):
+    return bert_config(vocab_size=256, hidden_size=64, num_layers=2,
+                       num_heads=4, max_seq_len=seq_len)
 
 
 # ---------------------------------------------------------------------------
@@ -390,10 +529,31 @@ def llama2_7b():
 # ---------------------------------------------------------------------------
 
 def init_cache(config: GPTConfig, batch: int, max_len: int):
-    """Per-layer KV cache [L, B, max_len, H, hd] (static shapes for jit)."""
+    """Per-layer KV cache [L, B, max_len, KVH, hd] (static shapes for jit).
+    GQA caches only the kv heads — the cache shrinks by H/KVH (the point of
+    GQA for serving)."""
     c = config
-    shape = (c.num_layers, batch, max_len, c.num_heads, c.head_dim)
+    shape = (c.num_layers, batch, max_len, c.kv_heads, c.head_dim)
     return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype)}
+
+
+def _ffn_dense(bp, h, c: GPTConfig):
+    """Dense-FFN body shared by the decode/prefill paths (gated + bias aware)."""
+    up = jnp.matmul(h, bp["fc1_w"])
+    if "fc1_b" in bp:
+        up = up + bp["fc1_b"]
+    act = jax.nn.gelu if c.activation == "gelu" else jax.nn.silu
+    if c.gated_ffn:
+        gate = jnp.matmul(h, bp["fcg_w"])
+        if "fcg_b" in bp:
+            gate = gate + bp["fcg_b"]
+        h = act(gate) * up
+    else:
+        h = act(up)
+    out = jnp.matmul(h, bp["fc2_w"])
+    if "fc2_b" in bp:
+        out = out + bp["fc2_b"]
+    return out
 
 
 def decode_step(params, token, cache, pos, config: GPTConfig):
@@ -404,8 +564,10 @@ def decode_step(params, token, cache, pos, config: GPTConfig):
     fused path; no flash kernel needed.
     """
     c = config
+    assert c.causal, "KV-cache decoding requires a causal model"
     B = token.shape[0]
-    D, H, hd = c.hidden_size, c.num_heads, c.head_dim
+    D, H, KVH, hd = c.hidden_size, c.num_heads, c.kv_heads, c.head_dim
+    G = H // KVH                                             # queries per kv head
     x = jnp.take(params["wte"], token, axis=0)               # [B, D]
     if not c.use_rope:
         x = x + jax.lax.dynamic_index_in_dim(params["wpe"], pos, keepdims=False)
@@ -414,33 +576,46 @@ def decode_step(params, token, cache, pos, config: GPTConfig):
     kv_pos = jnp.arange(max_len)
 
     def layer(x, layer_in):
-        bp, kc, vc = layer_in                                 # caches [B,S,H,hd]
-        h = _norm(x, bp["ln1_w"], bp["ln1_b"], c)
-        qkv = jnp.matmul(h, bp["qkv_w"]) + bp["qkv_b"]        # [B, 3D]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        bp, kc, vc = layer_in                               # caches [B,S,KVH,hd]
+        h = _norm(x, bp["ln1_w"], bp["ln1_b"], c) if c.norm_position == "pre" \
+            else x
+        qkv = jnp.matmul(h, bp["qkv_w"])                     # [B, qkv_dim]
+        if "qkv_b" in bp:
+            qkv = qkv + bp["qkv_b"]
+        q, k, v = jnp.split(qkv, [H * hd, (H + KVH) * hd], axis=-1)
         q = q.reshape(B, H, hd)
-        k = k.reshape(B, H, hd)
-        v = v.reshape(B, H, hd)
+        k = k.reshape(B, KVH, hd)
+        v = v.reshape(B, KVH, hd)
         if c.use_rope:
             sin, cos = _rope_tables(c, 1, pos_offset=pos)
             q = apply_rope(q[:, None], sin, cos)[:, 0]
             k = apply_rope(k[:, None], sin, cos)[:, 0]
         kc = jax.lax.dynamic_update_slice_in_dim(kc, k[:, None], pos, axis=1)
         vc = jax.lax.dynamic_update_slice_in_dim(vc, v[:, None], pos, axis=1)
-        s = jnp.einsum("bhd,bshd->bhs", q, kc,
+        # grouped attention against the KVH-head cache: q [B, KVH, G, hd]
+        qg = q.reshape(B, KVH, G, hd)
+        s = jnp.einsum("bkgd,bskd->bkgs", qg, kc,
                        preferred_element_type=jnp.float32) / math.sqrt(hd)
-        s = jnp.where((kv_pos <= pos)[None, None], s, -1e30)
+        s = jnp.where((kv_pos <= pos)[None, None, None], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
-        attn = jnp.einsum("bhs,bshd->bhd", p.astype(vc.dtype), vc)
-        x = x + jnp.matmul(attn.reshape(B, D), bp["proj_w"]) + bp["proj_b"]
-        h = _norm(x, bp["ln2_w"], bp["ln2_b"], c)
+        attn = jnp.einsum("bkgs,bskd->bkgd", p.astype(vc.dtype), vc)
+        attn = jnp.matmul(attn.reshape(B, D), bp["proj_w"])
+        if "proj_b" in bp:
+            attn = attn + bp["proj_b"]
+        x = x + attn
+        if c.norm_position != "pre":
+            x = _norm(x, bp["ln1_w"], bp["ln1_b"], c)
+        h = _norm(x, bp["ln2_w"], bp["ln2_b"], c) if c.norm_position == "pre" \
+            else x
         if c.moe_num_experts > 0:
             from ..incubate.distributed.models.moe.dispatch import moe_ffn_dense
             y, _ = moe_ffn_dense(bp, h, c)
-            return x + y, (kc, vc)
-        h = jnp.matmul(h, bp["fc1_w"]) + bp["fc1_b"]
-        h = jax.nn.gelu(h) if c.activation == "gelu" else jax.nn.silu(h)
-        return x + jnp.matmul(h, bp["fc2_w"]) + bp["fc2_b"], (kc, vc)
+        else:
+            y = _ffn_dense(bp, h, c)
+        x = x + y
+        if c.norm_position != "pre":
+            x = _norm(x, bp["ln2_w"], bp["ln2_b"], c)
+        return x, (kc, vc)
 
     def scan_body(carry, inp):
         out, kv = layer(carry, inp)
@@ -448,9 +623,8 @@ def decode_step(params, token, cache, pos, config: GPTConfig):
 
     x, (new_k, new_v) = jax.lax.scan(
         scan_body, x, (params["blocks"], cache["k"], cache["v"]))
-    x = _norm(x, params["lnf_w"], params["lnf_b"], c)
-    head = params["wte"].T if c.tie_word_embeddings else params["lm_head"]
-    return jnp.matmul(x, head), {"k": new_k, "v": new_v}
+    x = epilogue(params, x, c)
+    return jnp.matmul(x, head_matrix(params, c)), {"k": new_k, "v": new_v}
 
 
 def prefill(params, input_ids, config: GPTConfig, cache):
@@ -461,43 +635,58 @@ def prefill(params, input_ids, config: GPTConfig, cache):
     not Tp serial decode steps.
     """
     c = config
+    assert c.causal, "KV-cache decoding requires a causal model"
     B, Tp = input_ids.shape
-    D, H, hd = c.hidden_size, c.num_heads, c.head_dim
+    D, H, KVH, hd = c.hidden_size, c.num_heads, c.kv_heads, c.head_dim
     x = jnp.take(params["wte"], input_ids, axis=0)
     if not c.use_rope:
         x = x + params["wpe"][:Tp]
 
     def layer(x, layer_in):
         bp, kc, vc = layer_in
-        h = _norm(x, bp["ln1_w"], bp["ln1_b"], c)
-        qkv = jnp.matmul(h, bp["qkv_w"]) + bp["qkv_b"]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        h = _norm(x, bp["ln1_w"], bp["ln1_b"], c) if c.norm_position == "pre" \
+            else x
+        qkv = jnp.matmul(h, bp["qkv_w"])
+        if "qkv_b" in bp:
+            qkv = qkv + bp["qkv_b"]
+        q, k, v = jnp.split(qkv, [H * hd, (H + KVH) * hd], axis=-1)
         q = q.reshape(B, Tp, H, hd)
-        k = k.reshape(B, Tp, H, hd)
-        v = v.reshape(B, Tp, H, hd)
+        k = k.reshape(B, Tp, KVH, hd)
+        v = v.reshape(B, Tp, KVH, hd)
         if c.use_rope:
             sin, cos = _rope_tables(c, Tp)
             q = apply_rope(q, sin, cos)
             k = apply_rope(k, sin, cos)
         kc = jax.lax.dynamic_update_slice_in_dim(kc, k, 0, axis=1)
         vc = jax.lax.dynamic_update_slice_in_dim(vc, v, 0, axis=1)
+        if KVH != H:
+            k = jnp.repeat(k, H // KVH, axis=2)
+            v = jnp.repeat(v, H // KVH, axis=2)
         attn = flash_attention_fused(q, k, v, causal=True).reshape(B, Tp, D)
-        x = x + jnp.matmul(attn, bp["proj_w"]) + bp["proj_b"]
-        h = _norm(x, bp["ln2_w"], bp["ln2_b"], c)
+        attn = jnp.matmul(attn, bp["proj_w"])
+        if "proj_b" in bp:
+            attn = attn + bp["proj_b"]
+        x = x + attn
+        if c.norm_position != "pre":
+            x = _norm(x, bp["ln1_w"], bp["ln1_b"], c)
+        h = _norm(x, bp["ln2_w"], bp["ln2_b"], c) if c.norm_position == "pre" \
+            else x
         if c.moe_num_experts > 0:
             from ..incubate.distributed.models.moe.dispatch import moe_ffn_dense
             y, _ = moe_ffn_dense(bp, h.reshape(B * Tp, D), c)
-            return x + y.reshape(B, Tp, D), (kc, vc)
-        h = jnp.matmul(h, bp["fc1_w"]) + bp["fc1_b"]
-        h = jax.nn.gelu(h) if c.activation == "gelu" else jax.nn.silu(h)
-        return x + jnp.matmul(h, bp["fc2_w"]) + bp["fc2_b"], (kc, vc)
+            y = y.reshape(B, Tp, D)
+        else:
+            y = _ffn_dense(bp, h, c)
+        x = x + y
+        if c.norm_position != "pre":
+            x = _norm(x, bp["ln2_w"], bp["ln2_b"], c)
+        return x, (kc, vc)
 
     x, (new_k, new_v) = jax.lax.scan(
         lambda carry, inp: layer(carry, inp),
         x, (params["blocks"], cache["k"], cache["v"]))
-    x = _norm(x[:, -1], params["lnf_w"], params["lnf_b"], c)
-    head = params["wte"].T if c.tie_word_embeddings else params["lm_head"]
-    return jnp.matmul(x, head), {"k": new_k, "v": new_v}
+    x = epilogue(params, x[:, -1], c)
+    return jnp.matmul(x, head_matrix(params, c)), {"k": new_k, "v": new_v}
 
 
 _generate_cache: Dict[Any, Any] = {}
