@@ -6,6 +6,22 @@ idiomatic TPU (XLA kernels, GSPMD parallelism, jaxpr program capture) rather tha
 """
 from __future__ import annotations
 
+import os as _os
+
+import jax as _jax
+
+# Sharding-invariant RNG (the modern JAX default).  On old JAX the default
+# (False) lowers jitted `jax.random.*` with sharded out_shardings to
+# per-shard streams, so the SAME seed yields DIFFERENT params on different
+# meshes — which silently breaks every dp/mp-vs-single-device parity
+# guarantee the parallel trainers advertise.  This is a process-global knob;
+# an explicit JAX_THREEFRY_PARTITIONABLE env setting wins (see README).
+if "JAX_THREEFRY_PARTITIONABLE" not in _os.environ:
+    try:
+        _jax.config.update("jax_threefry_partitionable", True)
+    except Exception:  # flag removed once True became the only behavior
+        pass
+
 # ---- core ----
 from .core import dtype as _dtype_mod
 from .core.dtype import (bool_ as bool, uint8, int8, int16, int32, int64, float16,  # noqa

@@ -1,0 +1,185 @@
+"""Paged attention decode for TPU serving (ref vLLM PagedAttention, Kwon et al.
+SOSP 2023; reference repo counterpart: the fused variable-length attention used
+by `fluid/inference` / PaddleNLP generation predictors).
+
+The serving engine stores KV in a static pool of fixed-size pages
+(`[num_pages, page_size, KVH, hd]` per layer) plus a per-slot page table, so
+cache memory scales with live tokens instead of `B * max_seq_len`.  Decode
+attention then has to read each slot's keys/values *through* the page table:
+
+- `paged_attention_xla`: gather-based implementation (`pool[page_table]`) — the
+  CPU/debug fallback and the numerics oracle for tests.  XLA lowers the gather
+  to a dynamic-slice loop; fine at test scale, bandwidth-wasteful at pool scale
+  because the gathered `[B, S_max, KVH, hd]` copy round-trips HBM.
+- `paged_attention_pallas`: Pallas TPU kernel using `PrefetchScalarGridSpec` —
+  the page table and per-slot lengths are scalar-prefetched so the BlockSpec
+  index_map DMAs each slot's pages HBM->VMEM directly (no materialized gather),
+  with online-softmax accumulation over the page grid dimension and per-page
+  length masking.  Pages past a slot's length (including the reserved null
+  page 0) are masked out; whole pages beyond the length skip compute.
+
+Layout note: one query token per slot (`q [B, H, hd]`) — decode T=1 is the hot
+case the engine compiles once.  GQA folds into the kernel as G = H // KVH query
+rows per kv head.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .flash_attention import NEG_INF, _on_tpu
+
+
+def paged_attention_xla(q, k_pages, v_pages, page_table, lengths, scale=None):
+    """Gather-based paged decode attention (fallback + oracle).
+
+    q: [B, H, hd] — one query token per slot.
+    k_pages/v_pages: [P, page_size, KVH, hd] — the page pool for one layer.
+    page_table: [B, max_pages] int32 page ids (0 = reserved null page).
+    lengths: [B] int32 — number of valid tokens per slot (including the token
+        just written at position lengths-1).
+    Returns [B, H, hd].
+    """
+    B, H, hd = q.shape
+    page = k_pages.shape[1]
+    KVH = k_pages.shape[2]
+    G = H // KVH
+    S = page_table.shape[1] * page
+    s = scale if scale is not None else 1.0 / math.sqrt(hd)
+    k = k_pages[page_table].reshape(B, S, KVH, hd)
+    v = v_pages[page_table].reshape(B, S, KVH, hd)
+    qg = q.reshape(B, KVH, G, hd)
+    logits = jnp.einsum("bkgd,bskd->bkgs", qg, k,
+                        preferred_element_type=jnp.float32) * s
+    kv_pos = jnp.arange(S)
+    logits = jnp.where(kv_pos[None, None, None] < lengths[:, None, None, None],
+                       logits, NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgs,bskd->bkgd", p.astype(v.dtype), v)
+    return out.reshape(B, H, hd)
+
+
+def _paged_attn_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                       acc_ref, m_ref, l_ref, *, page: int, KVH: int, G: int,
+                       n_pages: int, scale: float):
+    """Grid (B, max_pages): slots parallel, pages innermost with online-softmax
+    scratch carry (acc, m, l) — same discipline as the flash forward kernel,
+    but the k/v blocks arrive via the scalar-prefetched page table."""
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    H = KVH * G
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    length = len_ref[b]
+    k_start = j * page
+
+    # whole page past the slot's length (null-page tail entries): skip compute
+    @pl.when(k_start < length)
+    def _compute():
+        q = q_ref[0]                                    # [H, hd]
+        k = k_ref[0]                                    # [page, KVH, hd]
+        v = v_ref[0]
+        # GQA: per-kv-head score tiles stacked back to [H, page] rows
+        rows = []
+        for kh in range(KVH):
+            qh = q[kh * G:(kh + 1) * G]                 # [G, hd]
+            rows.append(jnp.dot(qh, k[:, kh, :].T,
+                                preferred_element_type=jnp.float32))
+        s = (jnp.concatenate(rows, axis=0) if KVH > 1 else rows[0]) * scale
+        pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (1, page), 1)
+        s = jnp.where(pos < length, s, NEG_INF)         # [H, page]
+        m_prev = m_ref[...]
+        l_prev = l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                          # [H, page]
+        l_ref[...] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        upd = []
+        for kh in range(KVH):
+            ph = p[kh * G:(kh + 1) * G].astype(v.dtype)
+            upd.append(jnp.dot(ph, v[:, kh, :],
+                               preferred_element_type=jnp.float32))
+        pv = jnp.concatenate(upd, axis=0) if KVH > 1 else upd[0]   # [H, hd]
+        acc_ref[...] = acc_ref[...] * corr + pv
+        m_ref[...] = m_new
+
+    @pl.when(j == n_pages - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def paged_attention_pallas(q, k_pages, v_pages, page_table, lengths,
+                           scale=None, interpret=False):
+    """Pallas paged decode attention — same contract as `paged_attention_xla`.
+
+    The page table and lengths ride `PrefetchScalarGridSpec` so the k/v
+    BlockSpec index_maps resolve `pool[table[b, j]]` at DMA time; the pool is
+    never gathered into a dense per-slot copy.  `interpret=True` runs the
+    kernel on CPU for numerics tests.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, hd = q.shape
+    page = k_pages.shape[1]
+    KVH = k_pages.shape[2]
+    G = H // KVH
+    n_pages = page_table.shape[1]
+    s = scale if scale is not None else 1.0 / math.sqrt(hd)
+
+    kernel = functools.partial(_paged_attn_kernel, page=page, KVH=KVH, G=G,
+                               n_pages=n_pages, scale=s)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,          # (page_table, lengths)
+        grid=(B, n_pages),
+        in_specs=[
+            pl.BlockSpec((1, H, hd), lambda b, j, tbl, ln: (b, 0, 0)),
+            pl.BlockSpec((1, page, KVH, hd),
+                         lambda b, j, tbl, ln: (tbl[b, j], 0, 0, 0)),
+            pl.BlockSpec((1, page, KVH, hd),
+                         lambda b, j, tbl, ln: (tbl[b, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, hd), lambda b, j, tbl, ln: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, hd), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+        ],
+    )
+    cparams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, hd), q.dtype),
+        compiler_params=cparams(dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(jnp.asarray(page_table, jnp.int32), jnp.asarray(lengths, jnp.int32),
+      q, k_pages, v_pages)
+
+
+def _shapes_ok_for_pallas(q, k_pages):
+    hd = q.shape[-1]
+    page = k_pages.shape[1]
+    return hd in (64, 128, 256) and page % 8 == 0
+
+
+def paged_attention_decode(q, k_pages, v_pages, page_table, lengths,
+                           scale=None):
+    """Entry used by `models.gpt.decode_step_paged`: Pallas on TPU when the
+    layout is kernel-friendly, gather fallback otherwise."""
+    if _on_tpu() and _shapes_ok_for_pallas(q, k_pages):
+        return paged_attention_pallas(q, k_pages, v_pages, page_table, lengths,
+                                      scale=scale)
+    return paged_attention_xla(q, k_pages, v_pages, page_table, lengths,
+                               scale=scale)

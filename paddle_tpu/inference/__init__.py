@@ -187,5 +187,20 @@ def convert_to_mixed_precision(*a, **k):
         "export the program in bfloat16 (GPU pass-pipeline concept)")
 
 
+# Serving engine (continuous batching + paged KV cache) — lazy so importing
+# paddle_tpu.inference does not pull the model zoo in.
+_SERVING = {"LLMEngine": "engine", "Request": "engine",
+            "RequestOutput": "engine", "PagedKVCache": "cache"}
+
+
+def __getattr__(name):
+    if name in _SERVING:
+        import importlib
+        mod = importlib.import_module("." + _SERVING[name], __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
-           "PlaceType", "get_version", "convert_to_mixed_precision"]
+           "PlaceType", "get_version", "convert_to_mixed_precision",
+           "LLMEngine", "Request", "RequestOutput", "PagedKVCache"]

@@ -1,0 +1,89 @@
+"""Paged KV cache manager (ref vLLM block manager, Kwon et al. SOSP 2023).
+
+Host-side page accounting for the serving engine: a free list over a static
+device pool (`models.gpt.init_paged_cache`), per-slot page-table rows, and
+per-slot lengths.  All methods are O(pages) host operations — the device only
+ever sees the fixed-shape `[num_slots, max_pages_per_slot]` table and
+`[num_slots]` lengths, so the compiled decode step never changes shape.
+
+Allocation is reservation-based: a request's full footprint
+(prompt + max_new_tokens, rounded up to pages) is reserved at admission, so a
+running sequence can never hit out-of-pages mid-decode (preemption/swapping is
+an open item, see ROADMAP).  Page 0 is reserved as the null page: unreserved
+table entries point at it, inactive slots write to it, and attention masking
+by length guarantees it is never read.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+NULL_PAGE = 0
+
+
+class PagedKVCache:
+    """Page-table + free-list bookkeeping for `num_slots` decode slots over a
+    pool of `num_pages` pages of `page_size` tokens each."""
+
+    def __init__(self, num_pages: int, page_size: int, num_slots: int,
+                 max_pages_per_slot: int):
+        if page_size & (page_size - 1):
+            raise ValueError(f"page_size must be a power of 2, got {page_size}")
+        if num_pages < 2:
+            raise ValueError("need at least one real page beyond the null page")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.num_slots = num_slots
+        self.max_pages_per_slot = max_pages_per_slot
+        # page 0 reserved as the null page; ascending allocation order
+        self._free = list(range(num_pages - 1, 0, -1))
+        self.page_table = np.full((num_slots, max_pages_per_slot), NULL_PAGE,
+                                  np.int32)
+        self.lengths = np.zeros((num_slots,), np.int32)
+        self._used = {s: [] for s in range(num_slots)}
+
+    # ---- capacity queries -------------------------------------------------
+    @property
+    def num_free_pages(self) -> int:
+        return len(self._free)
+
+    def pages_needed(self, total_tokens: int) -> int:
+        return -(-total_tokens // self.page_size)
+
+    def can_allocate(self, total_tokens: int) -> bool:
+        n = self.pages_needed(total_tokens)
+        return n <= len(self._free) and n <= self.max_pages_per_slot
+
+    def token_capacity(self) -> int:
+        """Pool capacity in tokens (excludes the null page) — the number the
+        engine's memory claim is measured against (vs num_slots * max_len)."""
+        return (self.num_pages - 1) * self.page_size
+
+    # ---- slot lifecycle ---------------------------------------------------
+    def allocate(self, slot: int, total_tokens: int) -> np.ndarray:
+        """Reserve ceil(total_tokens / page_size) pages for `slot` and write
+        them into its table row.  Returns the row (view)."""
+        n = self.pages_needed(total_tokens)
+        if n > len(self._free):
+            raise RuntimeError(
+                f"out of KV pages: need {n}, free {len(self._free)}")
+        if n > self.max_pages_per_slot:
+            raise ValueError(
+                f"request footprint {total_tokens} tokens exceeds slot "
+                f"capacity {self.max_pages_per_slot * self.page_size}")
+        if self._used[slot]:
+            raise RuntimeError(f"slot {slot} already has pages")
+        pages = [self._free.pop() for _ in range(n)]
+        self._used[slot] = pages
+        self.page_table[slot, :] = NULL_PAGE
+        self.page_table[slot, :n] = pages
+        return self.page_table[slot]
+
+    def release(self, slot: int) -> None:
+        """Return a retired slot's pages to the free list."""
+        self._free.extend(reversed(self._used[slot]))
+        self._used[slot] = []
+        self.page_table[slot, :] = NULL_PAGE
+        self.lengths[slot] = 0
+
+    def pages_in_use(self) -> int:
+        return sum(len(p) for p in self._used.values())

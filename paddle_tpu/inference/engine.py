@@ -1,0 +1,302 @@
+"""Continuous-batching LLM serving engine.
+
+Reference lineage: the reference repo serves via `fluid/inference`'s
+AnalysisPredictor + PaddleNLP `generation` — a one-shot, whole-batch API.  For
+"heavy traffic from millions of users" (ROADMAP north star) that shape is
+wrong: every (batch, prompt_len, max_new) combination compiles a fresh
+program, cache memory is dense `B x max_seq_len`, and a long request blocks
+the batch.  This engine follows vLLM's paged KV cache (Kwon et al., SOSP 2023)
+and Orca's iteration-level scheduling (Yu et al., OSDI 2022), under the same
+"one jitted step, static shapes" discipline as the pretraining hot loop:
+
+- **Paged KV cache** — one static pool of `[num_pages, page_size, KVH, hd]`
+  pages per layer (`models.gpt.init_paged_cache`) + per-slot page tables
+  (`inference.cache.PagedKVCache`): memory scales with live tokens, pages
+  recycle as requests retire.
+- **Slot-indexed decode** — ONE compiled decode program of fixed batch
+  `num_slots` (`models.gpt.decode_step_paged`) serves a churning request set;
+  retired slots are refilled without recompiling.
+- **Bucketed prefill** — prompts pad to power-of-2 length buckets, bounding
+  the prefill executable count to the bucket count; prefill writes straight
+  into the slot's reserved pages.
+- **Scheduler** — each `step()` admits queued requests into free slots
+  (reservation-based page admission), runs one decode iteration over all
+  active slots, and retires finished sequences (EOS or max_new_tokens),
+  returning their pages to the free list.
+
+`bench_serve.py` replays a Poisson request stream through this engine and
+reports decode tokens/s/chip + compiled-program counts.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from collections import deque
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import gpt as gpt_mod
+from .cache import PagedKVCache
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request: prompt token ids + a decode budget."""
+    prompt: np.ndarray
+    max_new_tokens: int = 16
+    request_id: int = -1
+
+
+@dataclasses.dataclass
+class RequestOutput:
+    request_id: int
+    prompt: np.ndarray
+    token_ids: List[int]            # generated tokens (prompt excluded)
+    finish_reason: str              # "stop" (EOS) | "length" (budget)
+
+    @property
+    def tokens(self) -> np.ndarray:
+        """prompt + generated, the `generate()`-compatible view."""
+        return np.concatenate(
+            [np.asarray(self.prompt, np.int64), np.asarray(self.token_ids,
+                                                           np.int64)])
+
+
+@dataclasses.dataclass
+class _Running:
+    request: Request
+    slot: int
+    generated: List[int]
+
+
+def _pow2_buckets(lo: int, hi: int) -> List[int]:
+    out = []
+    b = lo
+    while b <= hi:
+        out.append(b)
+        b *= 2
+    return out
+
+
+class LLMEngine:
+    """Continuous-batching serving engine over the functional GPT core.
+
+    params/config: the `models.gpt` pytree + GPTConfig.  `num_slots` is the
+    fixed decode batch; `num_pages`/`page_size` size the KV pool (default pool
+    is half of the dense `num_slots * max_model_len` footprint — the paged
+    cache's whole point is that this still serves full-length traffic as long
+    as *live* tokens fit).  Greedy by default; temperature/top_k compile the
+    sampling variant of the same two executables.
+    """
+
+    def __init__(self, params, config: gpt_mod.GPTConfig, *,
+                 num_slots: int = 4, page_size: int = 16,
+                 num_pages: Optional[int] = None,
+                 max_model_len: Optional[int] = None,
+                 prefill_buckets: Optional[List[int]] = None,
+                 eos_token_id: Optional[int] = None,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 seed: int = 0):
+        self.params = params
+        self.config = config
+        self.eos_token_id = eos_token_id
+        max_model_len = max_model_len or config.max_seq_len
+        if max_model_len % page_size:
+            raise ValueError("max_model_len must be a multiple of page_size")
+        if not config.use_rope and max_model_len > config.max_seq_len:
+            # learned positions: jnp.take clamps past wpe's last row, which
+            # would be silently wrong — generate() raises here too
+            raise ValueError(
+                f"max_model_len {max_model_len} exceeds max_seq_len "
+                f"{config.max_seq_len} (learned positions)")
+        self.max_model_len = max_model_len
+        max_pages_per_slot = max_model_len // page_size
+        if num_pages is None:
+            # default: half the dense footprint (+ the null page)
+            num_pages = max(2, num_slots * max_pages_per_slot // 2 + 1)
+        if prefill_buckets is None:
+            prefill_buckets = _pow2_buckets(page_size, max_model_len)
+            if not prefill_buckets or prefill_buckets[-1] != max_model_len:
+                # non-power-of-2 max_model_len: cover the top tokens too
+                prefill_buckets.append(max_model_len)
+        self.buckets = sorted(prefill_buckets)
+        for b in self.buckets:
+            if b % page_size or b > max_model_len:
+                raise ValueError(f"bucket {b} incompatible with page_size "
+                                 f"{page_size} / max_model_len {max_model_len}")
+        self.cache = PagedKVCache(num_pages, page_size, num_slots,
+                                  max_pages_per_slot)
+        self._pool = gpt_mod.init_paged_cache(config, num_pages, page_size)
+        self._queue: deque = deque()
+        self._running: Dict[int, _Running] = {}
+        self._free_slots = list(range(num_slots - 1, -1, -1))
+        self._ids = itertools.count()
+        self._key = jax.random.key(seed)
+        self._outputs: Dict[int, RequestOutput] = {}
+
+        sample = bool(temperature and temperature > 0.0)
+
+        def pick(logits, key):
+            # gpt.sample_token is shared with generate() — parity by construction
+            return gpt_mod.sample_token(logits, key, sample=sample,
+                                        temperature=temperature, top_k=top_k)
+
+        cfg = config
+
+        def decode_impl(params, tokens, pool, table, lengths, key):
+            logits, pool = gpt_mod.decode_step_paged(params, tokens, pool,
+                                                     table, lengths, cfg)
+            nxt, key = pick(logits, key)
+            return nxt, pool, key
+
+        def prefill_impl(params, ids, pool, pages, length, key):
+            logits, pool = gpt_mod.prefill_paged(params, ids, cfg, pool,
+                                                 pages, length)
+            first, key = pick(logits, key)
+            return first, pool, key
+
+        # pool donated: the step updates it in place instead of copying the
+        # whole page pool every iteration
+        self._decode_fn = jax.jit(decode_impl, donate_argnums=(2,))
+        self._prefill_fn = jax.jit(prefill_impl, donate_argnums=(2,))
+        self._seen_buckets = set()
+        self._decode_iters = 0
+        self._decode_tokens = 0         # per-iteration ACTIVE slots summed
+
+    # ---- request intake ---------------------------------------------------
+    def add_request(self, prompt, max_new_tokens: int = 16) -> int:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, "
+                             f"got {max_new_tokens}")
+        if prompt.size > self.buckets[-1]:
+            raise ValueError(f"prompt length {prompt.size} exceeds largest "
+                             f"prefill bucket {self.buckets[-1]}")
+        total = prompt.size + max_new_tokens
+        if total > self.max_model_len:
+            raise ValueError(f"prompt + max_new_tokens = {total} exceeds "
+                             f"max_model_len {self.max_model_len}")
+        rid = next(self._ids)
+        self._queue.append(Request(prompt, max_new_tokens, rid))
+        return rid
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"no bucket for prompt length {n}")
+
+    # ---- scheduler --------------------------------------------------------
+    def step(self) -> List[RequestOutput]:
+        """One engine iteration: admit + prefill queued requests into free
+        slots, then one decode step over every active slot.  Returns the
+        requests that finished this iteration."""
+        finished: List[RequestOutput] = []
+        self._admit(finished)
+        if self._running:
+            self._decode_iter(finished)
+        return finished
+
+    def _admit(self, finished: List[RequestOutput]) -> None:
+        mgr = self.cache
+        while self._queue and self._free_slots:
+            req = self._queue[0]
+            total = req.prompt.size + req.max_new_tokens
+            if not mgr.can_allocate(total):
+                if not self._running and mgr.pages_in_use() == 0:
+                    # nothing will ever free: the footprint exceeds the pool
+                    raise ValueError(
+                        f"request {req.request_id} needs "
+                        f"{mgr.pages_needed(total)} pages but the pool only "
+                        f"has {mgr.num_pages - 1}; raise num_pages")
+                break                       # wait for pages to free up
+            self._queue.popleft()
+            slot = self._free_slots.pop()
+            row = mgr.allocate(slot, total)
+            lp = req.prompt.size
+            bucket = self._bucket_for(lp)
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :lp] = req.prompt
+            pages = row[:bucket // mgr.page_size][None, :]
+            first, self._pool, self._key = self._prefill_fn(
+                self.params, jnp.asarray(ids), self._pool,
+                jnp.asarray(pages), jnp.asarray([lp], jnp.int32), self._key)
+            self._seen_buckets.add(bucket)
+            mgr.lengths[slot] = lp
+            seq = _Running(req, slot, [int(np.asarray(first)[0])])
+            if not self._maybe_finish(seq, finished):
+                self._running[slot] = seq
+
+    def _decode_iter(self, finished: List[RequestOutput]) -> None:
+        mgr = self.cache
+        tokens = np.zeros((mgr.num_slots,), np.int32)
+        for slot, seq in self._running.items():
+            tokens[slot] = seq.generated[-1]
+        nxt, self._pool, self._key = self._decode_fn(
+            self.params, jnp.asarray(tokens), self._pool,
+            jnp.asarray(mgr.page_table), jnp.asarray(mgr.lengths), self._key)
+        self._decode_iters += 1
+        self._decode_tokens += len(self._running)
+        nxt = np.asarray(nxt)
+        for slot, seq in list(self._running.items()):
+            mgr.lengths[slot] += 1          # the token we just fed is cached
+            seq.generated.append(int(nxt[slot]))
+            if self._maybe_finish(seq, finished):
+                del self._running[slot]
+
+    def _maybe_finish(self, seq: _Running,
+                      finished: List[RequestOutput]) -> bool:
+        reason = None
+        if self.eos_token_id is not None and \
+                seq.generated[-1] == self.eos_token_id:
+            reason = "stop"
+        elif len(seq.generated) >= seq.request.max_new_tokens:
+            reason = "length"
+        if reason is None:
+            return False
+        self.cache.release(seq.slot)
+        self._free_slots.append(seq.slot)
+        out = RequestOutput(seq.request.request_id, seq.request.prompt,
+                            seq.generated, reason)
+        self._outputs[out.request_id] = out
+        finished.append(out)
+        return True
+
+    def run(self) -> Dict[int, RequestOutput]:
+        """Drain the queue: step until every request completes.  Returns
+        {request_id: RequestOutput} for everything finished so far."""
+        while self._queue or self._running:
+            self.step()
+        return dict(self._outputs)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._queue or self._running)
+
+    # ---- observability ----------------------------------------------------
+    def stats(self) -> Dict[str, object]:
+        def execs(fn, fallback):
+            try:
+                return fn._cache_size()
+            except Exception:
+                return fallback
+        return {
+            "decode_executables": execs(self._decode_fn,
+                                        1 if self._decode_iters else 0),
+            "prefill_executables": execs(self._prefill_fn,
+                                         len(self._seen_buckets)),
+            "buckets": list(self.buckets),
+            "decode_iterations": self._decode_iters,
+            "decode_tokens": self._decode_tokens,
+            "pages_in_use": self.cache.pages_in_use(),
+            "pages_free": self.cache.num_free_pages,
+            "kv_token_capacity": self.cache.token_capacity(),
+            "dense_token_footprint": self.cache.num_slots * self.max_model_len,
+            "queued": len(self._queue),
+            "running": len(self._running),
+        }

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+from collections import OrderedDict
 from typing import Any, Dict, Optional
 
 import jax
@@ -170,10 +171,14 @@ def init_params(config: GPTConfig, key) -> Dict[str, Any]:
 
 
 def pvary_compat(x, axes):
-    """Mark x varying over manual mesh axes (pvary was deprecated for pcast)."""
+    """Mark x varying over manual mesh axes (pvary was deprecated for pcast).
+    Old JAX (< 0.5) has neither and no varying-axes tracking at all (shard_map
+    runs check_rep=False there) — identity is the correct no-op."""
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, axes, to="varying")
-    return jax.lax.pvary(x, axes)
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, axes)
+    return x
 
 
 def _norm(x, w, b, config):
@@ -190,7 +195,8 @@ def _rope_tables(config, S, pos_offset=None):
     inv = 1.0 / (10000.0 ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
     t = jnp.arange(S, dtype=jnp.float32)
     if pos_offset is not None:
-        t = t + pos_offset.astype(jnp.float32)  # context-parallel seq shard
+        # context-parallel seq shard / decode position (traced or plain int)
+        t = t + jnp.asarray(pos_offset, jnp.float32)
     freqs = jnp.outer(t, inv)
     return jnp.sin(freqs), jnp.cos(freqs)
 
@@ -556,6 +562,80 @@ def _ffn_dense(bp, h, c: GPTConfig):
     return out
 
 
+def _decode_qkv(bp, x, c: GPTConfig, pos):
+    """Pre-norm + packed qkv + rope for a single-token decode input.
+
+    x [B, D]; pos is a scalar (dense contiguous cache) or a [B] vector
+    (per-slot positions, the paged engine's slot-indexed decode).
+    Returns post-rope q [B, H, hd], k, v [B, KVH, hd]."""
+    B = x.shape[0]
+    H, KVH, hd = c.num_heads, c.kv_heads, c.head_dim
+    h = _norm(x, bp["ln1_w"], bp["ln1_b"], c) if c.norm_position == "pre" \
+        else x
+    qkv = jnp.matmul(h, bp["qkv_w"])
+    if "qkv_b" in bp:
+        qkv = qkv + bp["qkv_b"]
+    q, k, v = jnp.split(qkv, [H * hd, (H + KVH) * hd], axis=-1)
+    q = q.reshape(B, H, hd)
+    k = k.reshape(B, KVH, hd)
+    v = v.reshape(B, KVH, hd)
+    if c.use_rope:
+        sin, cos = _rope_tables(c, 1, pos_offset=pos)
+        if jnp.ndim(pos) > 0:
+            # per-slot positions: tables are [B, half] -> feed apply_rope's
+            # batched [B, S=1, half] branch
+            sin, cos = sin[:, None], cos[:, None]
+        q = apply_rope(q[:, None], sin, cos)[:, 0]
+        k = apply_rope(k[:, None], sin, cos)[:, 0]
+    return q, k, v
+
+
+def _prefill_qkv(bp, x, c: GPTConfig):
+    """Pre-norm + packed qkv + rope over a [B, T, D] prompt (positions 0..T-1).
+    Returns post-rope q [B, T, H, hd], k, v [B, T, KVH, hd]."""
+    B, T, _ = x.shape
+    H, KVH, hd = c.num_heads, c.kv_heads, c.head_dim
+    h = _norm(x, bp["ln1_w"], bp["ln1_b"], c) if c.norm_position == "pre" \
+        else x
+    qkv = jnp.matmul(h, bp["qkv_w"])
+    if "qkv_b" in bp:
+        qkv = qkv + bp["qkv_b"]
+    q, k, v = jnp.split(qkv, [H * hd, (H + KVH) * hd], axis=-1)
+    q = q.reshape(B, T, H, hd)
+    k = k.reshape(B, T, KVH, hd)
+    v = v.reshape(B, T, KVH, hd)
+    if c.use_rope:
+        sin, cos = _rope_tables(c, T)
+        q = apply_rope(q, sin, cos)
+        k = apply_rope(k, sin, cos)
+    return q, k, v
+
+
+def _layer_tail(bp, x, attn, c: GPTConfig):
+    """Shared post-attention half of a decode/prefill block: out-proj +
+    residual (+ post-LN) + FFN/MoE + residual (+ post-LN).  attn is the
+    head-flattened [..., D] attention output, x the block input (same rank)."""
+    attn = jnp.matmul(attn, bp["proj_w"])
+    if "proj_b" in bp:
+        attn = attn + bp["proj_b"]
+    x = x + attn
+    if c.norm_position != "pre":
+        x = _norm(x, bp["ln1_w"], bp["ln1_b"], c)
+    h = _norm(x, bp["ln2_w"], bp["ln2_b"], c) if c.norm_position == "pre" \
+        else x
+    if c.moe_num_experts > 0:
+        from ..incubate.distributed.models.moe.dispatch import moe_ffn_dense
+        lead = h.shape[:-1]
+        y, _ = moe_ffn_dense(bp, h.reshape(-1, c.hidden_size), c)
+        y = y.reshape(*lead, c.hidden_size)
+    else:
+        y = _ffn_dense(bp, h, c)
+    x = x + y
+    if c.norm_position != "pre":
+        x = _norm(x, bp["ln2_w"], bp["ln2_b"], c)
+    return x
+
+
 def decode_step(params, token, cache, pos, config: GPTConfig):
     """One autoregressive step: token [B] int32 at position `pos` (traced).
 
@@ -577,19 +657,7 @@ def decode_step(params, token, cache, pos, config: GPTConfig):
 
     def layer(x, layer_in):
         bp, kc, vc = layer_in                               # caches [B,S,KVH,hd]
-        h = _norm(x, bp["ln1_w"], bp["ln1_b"], c) if c.norm_position == "pre" \
-            else x
-        qkv = jnp.matmul(h, bp["qkv_w"])                     # [B, qkv_dim]
-        if "qkv_b" in bp:
-            qkv = qkv + bp["qkv_b"]
-        q, k, v = jnp.split(qkv, [H * hd, (H + KVH) * hd], axis=-1)
-        q = q.reshape(B, H, hd)
-        k = k.reshape(B, KVH, hd)
-        v = v.reshape(B, KVH, hd)
-        if c.use_rope:
-            sin, cos = _rope_tables(c, 1, pos_offset=pos)
-            q = apply_rope(q[:, None], sin, cos)[:, 0]
-            k = apply_rope(k[:, None], sin, cos)[:, 0]
+        q, k, v = _decode_qkv(bp, x, c, pos)
         kc = jax.lax.dynamic_update_slice_in_dim(kc, k[:, None], pos, axis=1)
         vc = jax.lax.dynamic_update_slice_in_dim(vc, v[:, None], pos, axis=1)
         # grouped attention against the KVH-head cache: q [B, KVH, G, hd]
@@ -599,22 +667,7 @@ def decode_step(params, token, cache, pos, config: GPTConfig):
         s = jnp.where((kv_pos <= pos)[None, None, None], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         attn = jnp.einsum("bkgs,bskd->bkgd", p.astype(vc.dtype), vc)
-        attn = jnp.matmul(attn.reshape(B, D), bp["proj_w"])
-        if "proj_b" in bp:
-            attn = attn + bp["proj_b"]
-        x = x + attn
-        if c.norm_position != "pre":
-            x = _norm(x, bp["ln1_w"], bp["ln1_b"], c)
-        h = _norm(x, bp["ln2_w"], bp["ln2_b"], c) if c.norm_position == "pre" \
-            else x
-        if c.moe_num_experts > 0:
-            from ..incubate.distributed.models.moe.dispatch import moe_ffn_dense
-            y, _ = moe_ffn_dense(bp, h, c)
-        else:
-            y = _ffn_dense(bp, h, c)
-        x = x + y
-        if c.norm_position != "pre":
-            x = _norm(x, bp["ln2_w"], bp["ln2_b"], c)
+        x = _layer_tail(bp, x, attn.reshape(B, D), c)
         return x, (kc, vc)
 
     def scan_body(carry, inp):
@@ -644,42 +697,14 @@ def prefill(params, input_ids, config: GPTConfig, cache):
 
     def layer(x, layer_in):
         bp, kc, vc = layer_in
-        h = _norm(x, bp["ln1_w"], bp["ln1_b"], c) if c.norm_position == "pre" \
-            else x
-        qkv = jnp.matmul(h, bp["qkv_w"])
-        if "qkv_b" in bp:
-            qkv = qkv + bp["qkv_b"]
-        q, k, v = jnp.split(qkv, [H * hd, (H + KVH) * hd], axis=-1)
-        q = q.reshape(B, Tp, H, hd)
-        k = k.reshape(B, Tp, KVH, hd)
-        v = v.reshape(B, Tp, KVH, hd)
-        if c.use_rope:
-            sin, cos = _rope_tables(c, Tp)
-            q = apply_rope(q, sin, cos)
-            k = apply_rope(k, sin, cos)
+        q, k, v = _prefill_qkv(bp, x, c)
         kc = jax.lax.dynamic_update_slice_in_dim(kc, k, 0, axis=1)
         vc = jax.lax.dynamic_update_slice_in_dim(vc, v, 0, axis=1)
         if KVH != H:
             k = jnp.repeat(k, H // KVH, axis=2)
             v = jnp.repeat(v, H // KVH, axis=2)
         attn = flash_attention_fused(q, k, v, causal=True).reshape(B, Tp, D)
-        attn = jnp.matmul(attn, bp["proj_w"])
-        if "proj_b" in bp:
-            attn = attn + bp["proj_b"]
-        x = x + attn
-        if c.norm_position != "pre":
-            x = _norm(x, bp["ln1_w"], bp["ln1_b"], c)
-        h = _norm(x, bp["ln2_w"], bp["ln2_b"], c) if c.norm_position == "pre" \
-            else x
-        if c.moe_num_experts > 0:
-            from ..incubate.distributed.models.moe.dispatch import moe_ffn_dense
-            y, _ = moe_ffn_dense(bp, h.reshape(B * Tp, D), c)
-            y = y.reshape(B, Tp, D)
-        else:
-            y = _ffn_dense(bp, h, c)
-        x = x + y
-        if c.norm_position != "pre":
-            x = _norm(x, bp["ln2_w"], bp["ln2_b"], c)
+        x = _layer_tail(bp, x, attn, c)
         return x, (kc, vc)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -689,7 +714,137 @@ def prefill(params, input_ids, config: GPTConfig, cache):
     return jnp.matmul(x, head_matrix(params, c)), {"k": new_k, "v": new_v}
 
 
-_generate_cache: Dict[Any, Any] = {}
+# ---------------------------------------------------------------------------
+# Paged KV cache (ref vLLM PagedAttention, SOSP 2023): KV lives in a static
+# pool of fixed-size pages + per-slot page tables, so serving memory scales
+# with live tokens instead of B x max_seq_len.  `inference.engine.LLMEngine`
+# owns the page accounting; these are the compiled model-side steps.
+# ---------------------------------------------------------------------------
+
+def init_paged_cache(config: GPTConfig, num_pages: int, page_size: int):
+    """Per-layer paged KV pool [L, num_pages, page_size, KVH, hd].
+    Page 0 is reserved as the null page: inactive slots and padded bucket
+    tails write there, and it is never read (masked by per-slot length)."""
+    c = config
+    shape = (c.num_layers, num_pages, page_size, c.kv_heads, c.head_dim)
+    return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype)}
+
+
+def decode_step_paged(params, tokens, cache, page_table, lengths,
+                      config: GPTConfig):
+    """Slot-indexed decode against the paged pool — ONE fixed-shape executable
+    serves a churning request set (the continuous-batching hot loop).
+
+    tokens [B] int32 — last emitted token per slot; cache {"k","v"}
+    [L, P, page, KVH, hd]; page_table [B, max_pages] int32 page ids (0 = null
+    page); lengths [B] int32 — tokens already cached per slot.  The new
+    token's KV is written at position lengths[b] and attention masks each slot
+    to its own lengths[b] + 1 positions.  Inactive slots (lengths 0, all-null
+    table row) compute garbage the scheduler ignores.
+
+    Returns (logits [B, V], updated cache).
+    """
+    from ..incubate.kernels.paged_attention import paged_attention_decode
+    c = config
+    assert c.causal, "KV-cache decoding requires a causal model"
+    B = tokens.shape[0]
+    page = cache["k"].shape[2]
+    pos = lengths
+    x = jnp.take(params["wte"], tokens, axis=0)              # [B, D]
+    if not c.use_rope:
+        x = x + jnp.take(params["wpe"], pos, axis=0)
+    page_idx = jnp.take_along_axis(page_table, (pos // page)[:, None],
+                                   axis=1)[:, 0]             # [B]
+    offset = pos % page
+
+    def layer(x, layer_in):
+        bp, kc, vc = layer_in                        # pool [P, page, KVH, hd]
+        q, k, v = _decode_qkv(bp, x, c, pos)
+        kc = kc.at[page_idx, offset].set(k)          # batched page scatter
+        vc = vc.at[page_idx, offset].set(v)
+        attn = paged_attention_decode(q, kc, vc, page_table, pos + 1)
+        x = _layer_tail(bp, x, attn.reshape(B, c.hidden_size), c)
+        return x, (kc, vc)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        lambda carry, inp: layer(carry, inp),
+        x, (params["blocks"], cache["k"], cache["v"]))
+    x = epilogue(params, x, c)
+    return jnp.matmul(x, head_matrix(params, c)), {"k": new_k, "v": new_v}
+
+
+def prefill_paged(params, input_ids, config: GPTConfig, cache, pages, length):
+    """Bucketed paged prefill: one dense causal pass over the bucket-padded
+    prompt that writes KV into the slot's pages and returns logits at the last
+    REAL position (right padding is sound under causal attention: position
+    length-1 never attends to the padded tail).
+
+    input_ids [B, Sb] right-padded to the bucket; pages [B, Sb // page_size]
+    page ids (entries past the slot's reserved pages are the null page 0);
+    length [B] int32 real prompt lengths.  Pool positions >= length hold
+    padding garbage — masked by length during decode, overwritten as decode
+    appends real tokens.  Returns (logits [B, V], cache).
+    """
+    c = config
+    assert c.causal, "KV-cache decoding requires a causal model"
+    B, Sb = input_ids.shape
+    D, H, KVH, hd = c.hidden_size, c.num_heads, c.kv_heads, c.head_dim
+    page = cache["k"].shape[2]
+    n_chunks = Sb // page
+    x = jnp.take(params["wte"], input_ids, axis=0)
+    if not c.use_rope:
+        x = x + params["wpe"][:Sb]
+
+    def layer(x, layer_in):
+        bp, kc, vc = layer_in
+        q, k, v = _prefill_qkv(bp, x, c)
+        kc = kc.at[pages].set(k.reshape(B, n_chunks, page, KVH, hd))
+        vc = vc.at[pages].set(v.reshape(B, n_chunks, page, KVH, hd))
+        if KVH != H:
+            k = jnp.repeat(k, H // KVH, axis=2)
+            v = jnp.repeat(v, H // KVH, axis=2)
+        attn = flash_attention_fused(q, k, v, causal=True).reshape(B, Sb, D)
+        x = _layer_tail(bp, x, attn, c)
+        return x, (kc, vc)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        lambda carry, inp: layer(carry, inp),
+        x, (params["blocks"], cache["k"], cache["v"]))
+    x = x[jnp.arange(B), length - 1]                 # last real position
+    x = epilogue(params, x, c)
+    return jnp.matmul(x, head_matrix(params, c)), {"k": new_k, "v": new_v}
+
+
+# LRU-bounded executable cache for `generate` (unbounded it leaks one compiled
+# program per (config, B, Tp, max_new, sampling) combination — a real leak
+# under varied prompt shapes; the serving engine bounds shapes by bucketing
+# instead, see inference/engine.py).
+GENERATE_CACHE_MAX = 16
+_generate_cache: "OrderedDict[Any, Any]" = OrderedDict()
+_generate_compiles = 0
+
+
+def generate_cache_stats():
+    """{'size', 'compiles', 'max_size'} — benches/tests assert on `compiles`
+    to catch shape-churn recompilation regressions."""
+    return {"size": len(_generate_cache), "compiles": _generate_compiles,
+            "max_size": GENERATE_CACHE_MAX}
+
+
+def sample_token(logits, key, *, sample, temperature, top_k):
+    """Greedy argmax or temperature/top-k sample over [B, V] logits.
+
+    The ONE sampling implementation shared by `generate` and the serving
+    engine (`inference.engine.LLMEngine`) so their outputs cannot drift.
+    `temperature` may be a traced scalar.  Returns (ids [B] int32, key)."""
+    if sample:
+        key, sub = jax.random.split(key)
+        lg = logits / temperature
+        if top_k:
+            kth = jax.lax.top_k(lg, top_k)[0][:, -1:]
+            lg = jnp.where(lg < kth, -1e30, lg)
+        return jax.random.categorical(sub, lg).astype(jnp.int32), key
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), key
 
 
 def generate(params, input_ids, config: GPTConfig, max_new_tokens: int = 32,
@@ -715,20 +870,15 @@ def generate(params, input_ids, config: GPTConfig, max_new_tokens: int = 32,
     cache_key = (dataclasses.astuple(config), B, Tp, max_new_tokens,
                  sample, top_k, eos_token_id)
     fn = _generate_cache.get(cache_key)
-    if fn is None:
+    if fn is not None:
+        _generate_cache.move_to_end(cache_key)      # LRU touch
+    else:
         def impl(params, ids, temp, key):
             kv = init_cache(config, B, total)
 
             def pick(logits, key_):
-                if sample:
-                    key_, sub = jax.random.split(key_)
-                    lg = logits / temp
-                    if top_k:
-                        kth = jax.lax.top_k(lg, top_k)[0][:, -1:]
-                        lg = jnp.where(lg < kth, -1e30, lg)
-                    return (jax.random.categorical(sub, lg).astype(jnp.int32),
-                            key_)
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32), key_
+                return sample_token(logits, key_, sample=sample,
+                                    temperature=temp, top_k=top_k)
 
             logits, kv = prefill(params, ids, config, kv)
             first, key = pick(logits, key)
@@ -758,6 +908,10 @@ def generate(params, input_ids, config: GPTConfig, max_new_tokens: int = 32,
             return tokens
 
         fn = jax.jit(impl)
+        global _generate_compiles
+        _generate_compiles += 1
         _generate_cache[cache_key] = fn
+        while len(_generate_cache) > GENERATE_CACHE_MAX:
+            _generate_cache.popitem(last=False)     # evict least-recently-used
     return fn(params, jnp.asarray(input_ids, jnp.int32),
               jnp.asarray(temperature if sample else 1.0, jnp.float32), key)

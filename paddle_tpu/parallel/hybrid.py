@@ -8,7 +8,7 @@ sharding optimizer):
   column-split, proj/fc2 row-split, vocab-split embedding); the batch is sharded over
   dp; XLA inserts the exact allreduce/allgather/reduce-scatter set the reference codes
   by hand in mp_ops.py and the DP reducer — fused into the backward schedule.
-- **pp**: a GPipe microbatch loop written with `jax.shard_map(axis_names={'pp'})` +
+- **pp**: a GPipe microbatch loop written with `shard_map_compat(axis_names={'pp'})` +
   `ppermute` inside the SAME jitted program — stages exchange activations over ICI
   each tick; `jax.grad` differentiates through the scan, producing the reverse
   pipeline automatically (the reference's hand-written 1F1B send/recv schedule,
@@ -31,6 +31,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models import gpt as gpt_mod
+from .ring_attention import shard_map_compat
 
 
 @dataclasses.dataclass
@@ -79,12 +80,15 @@ def gpt_param_specs(cfg: MeshConfig, model_config=None):
     pp = "pp" if cfg.pp > 1 else None
     mp = "mp" if cfg.mp > 1 else None
     ep = "ep" if cfg.ep > 1 else None
+    use_bias = model_config is None or model_config.use_bias
     blocks = {
         "ln1_w": P(pp, None), "ln1_b": P(pp, None),
-        "qkv_w": P(pp, None, mp), "qkv_b": P(pp, mp),
-        "proj_w": P(pp, mp, None), "proj_b": P(pp, None),
+        "qkv_w": P(pp, None, mp),
+        "proj_w": P(pp, mp, None),
         "ln2_w": P(pp, None), "ln2_b": P(pp, None),
     }
+    if use_bias:
+        blocks.update({"qkv_b": P(pp, mp), "proj_b": P(pp, None)})
     if model_config is not None and model_config.moe_num_experts > 0:
         # experts shard over 'ep' on the E dim (ref: experts distributed across
         # the moe_group ranks, dispatched via global_scatter) — router replicated
@@ -95,9 +99,16 @@ def gpt_param_specs(cfg: MeshConfig, model_config=None):
         })
     else:
         blocks.update({
-            "fc1_w": P(pp, None, mp), "fc1_b": P(pp, mp),
-            "fc2_w": P(pp, mp, None), "fc2_b": P(pp, None),
+            "fc1_w": P(pp, None, mp),
+            "fc2_w": P(pp, mp, None),
         })
+        if use_bias:
+            blocks.update({"fc1_b": P(pp, mp), "fc2_b": P(pp, None)})
+        if model_config is not None and model_config.gated_ffn:
+            # gate projection is column-split like fc1 (Megatron SwiGLU layout)
+            blocks["fcg_w"] = P(pp, None, mp)
+            if use_bias:
+                blocks["fcg_b"] = P(pp, mp)
     specs = {
         "wte": P(mp, None),
         "blocks": blocks,
@@ -206,7 +217,7 @@ def _moe_ffn_ep(bp, x, config, cfg: MeshConfig, mesh):
         y, aux = _moe_local(bp_local, x_l, config, cfg.ep)
         return y, jax.lax.psum(aux, "ep") / cfg.ep
 
-    return jax.shard_map(
+    return shard_map_compat(
         local, mesh=mesh, axis_names={"ep"},
         in_specs=(P(), P("ep"), P("ep"), P("ep"), P("ep"), P("ep")),
         out_specs=(P("ep"), P()))(
@@ -248,7 +259,7 @@ def _cp_loss(params, tokens, labels, config, cfg: MeshConfig, mesh):
         return h, jax.lax.psum(aux, "cp")
 
     blk_specs = jax.tree_util.tree_map(lambda _: P(), params["blocks"])
-    h, aux = jax.shard_map(
+    h, aux = shard_map_compat(
         local, mesh=mesh, axis_names={"cp"},
         in_specs=(blk_specs, P(), P(), P(None, "cp", None)),
         out_specs=(P(None, "cp", None), P()))(
@@ -283,7 +294,7 @@ def _vp_embed(wte, tokens, mesh, cfg: MeshConfig):
         e = jnp.where(ok[..., None], e, jnp.zeros((), e.dtype))
         return jax.lax.psum(e, "mp")
 
-    return jax.shard_map(local, mesh=mesh, axis_names={"mp"},
+    return shard_map_compat(local, mesh=mesh, axis_names={"mp"},
                          in_specs=(P("mp", None), P()), out_specs=P())(wte, tokens)
 
 
@@ -353,7 +364,7 @@ def _vp_ce(h, head, labels, mesh, cfg: MeshConfig):
     spec_b = P(batch_axes if batch_axes else None,
                seq_axes if seq_axes else None)
     spec_head = P(None, "mp") if have_mp else P()
-    ls, n = jax.shard_map(local, mesh=mesh, axis_names=manual,
+    ls, n = shard_map_compat(local, mesh=mesh, axis_names=manual,
                           in_specs=(spec_b, spec_head, spec_b),
                           out_specs=(P(), P()))(h, head, labels)
     return ls / jnp.maximum(n, 1.0)
@@ -459,11 +470,13 @@ def _pp_loss(params, tokens, labels, config, cfg: MeshConfig, mesh):
             nxt = jax.lax.ppermute(out, "pp",
                                    [(i, (i + 1) % Ppp) for i in range(Ppp)])
             # invalid (warmup/cooldown) ticks run on garbage; mask their aux
-            return (nxt, aux_acc + aux * valid.astype(aux.dtype)), out
+            return (nxt, aux_acc + (aux * valid.astype(aux.dtype))[None]), out
 
         buf0 = gpt_mod.pvary_compat(jnp.zeros((mb_l, S_l, D), xs_rep.dtype),
                                     manual)
-        aux0 = gpt_mod.pvary_compat(jnp.zeros((), jnp.float32), manual)
+        # aux rides the boundary rank-1: old-JAX shard_map autodiff fails
+        # to promote scalar residuals (_SpecError), and a (1,) lane is free
+        aux0 = gpt_mod.pvary_compat(jnp.zeros((1,), jnp.float32), manual)
         (_, aux_sum), outs = jax.lax.scan(tick, (buf0, aux0), jnp.arange(T))
         # drop warmup/cooldown garbage IN-shard: only M ticks (and their grad
         # cotangents) cross the shard_map boundary.  The finish ticks are
@@ -491,11 +504,12 @@ def _pp_loss(params, tokens, labels, config, cfg: MeshConfig, mesh):
                 "cp" if cp_manual else None)
     out_spec = P("pp", "ep" if moe_manual else None,
                  "cp" if cp_manual else None)
-    f = jax.shard_map(
+    f = shard_map_compat(
         local_fn, mesh=mesh, axis_names=set(manual),
         in_specs=(blk_in, xs_spec),
         out_specs=(out_spec, P()))
     stacked_all, aux_sum = f(blocks_arg, xs)   # [Ppp*M, mb, S, D]
+    aux_sum = aux_sum[0]
     if moe_manual:
         aux_sum = aux_sum / cfg.ep
     # each stage contributed M sliced ticks; the last stage's hold finished
@@ -567,6 +581,7 @@ class HybridParallelTrainer:
             out_shardings={"m": m_shardings, "v": m_shardings, "step": None})
         self.opt_state = init_opt(self.params)
         self._step_fn = self._build_step()
+        self._eval_fn = None    # built lazily on first eval_loss
 
     # ---- sharding constraint hook handed to the model ----
     def _mp_constraint(self, x, kind):
@@ -673,5 +688,12 @@ class HybridParallelTrainer:
         return loss
 
     def eval_loss(self, tokens, labels):
-        return gpt_mod.loss_fn(self.params, jnp.asarray(tokens), jnp.asarray(labels),
-                               self.config)
+        # jitted once with the trainer's param shardings and reused — the old
+        # eager loss_fn call retraced the whole model on every eval batch
+        if self._eval_fn is None:
+            config = self.config
+            self._eval_fn = jax.jit(
+                lambda p, t, l: gpt_mod.loss_fn(p, t, l, config),
+                in_shardings=(self.param_shardings, None, None))
+        return self._eval_fn(self.params, jnp.asarray(tokens),
+                             jnp.asarray(labels))

@@ -9,3 +9,9 @@ os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # tier-1 runs `-m "not slow"` (ROADMAP): 'slow' holds the compile-heavy
+    # deep parallel-combo parity tests that would blow the tier-1 time budget
+    config.addinivalue_line("markers", "slow: excluded from the tier-1 suite")

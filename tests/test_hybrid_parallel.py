@@ -72,6 +72,7 @@ def test_param_shardings_are_applied():
     assert "dp" in tuple(m_wte.sharding.spec)
 
 
+@pytest.mark.slow      # deep-combo compile cost; tier-1 keeps a cheap representative
 def test_graft_entry_dryrun():
     import importlib.util
     spec = importlib.util.spec_from_file_location(
@@ -118,6 +119,7 @@ def test_zero3_param_and_moment_bytes_shrink():
     assert z3_m < 0.25 * full_m, f"stage-3 moments not sharded: {z3_m} vs {full_m}"
 
 
+@pytest.mark.slow      # deep-combo compile cost; tier-1 keeps a cheap representative
 def test_zero_stages_loss_parity():
     import jax
     import numpy as np
@@ -210,6 +212,7 @@ def test_pp4_parity():
         np.testing.assert_allclose(l1, l0, rtol=2e-4)
 
 
+@pytest.mark.slow      # deep-combo compile cost; tier-1 keeps a cheap representative
 def test_interleaved_virtual_pipeline_matches_single():
     """vpp>1 (ref PipelineParallelWithInterleave :822): non-contiguous layer
     chunks per stage, Megatron closed-form schedule; parity vs single chip."""

@@ -54,6 +54,7 @@ def test_moe_ep2_matches_dense():
     np.testing.assert_allclose(got, ref, rtol=1e-4)
 
 
+@pytest.mark.slow      # deep-combo compile cost; tier-1 keeps a cheap representative
 def test_moe_dp2_ep2_mp2_matches_dense():
     cfg = _cfg_nodrop()
     tok, lab = _data(cfg)
@@ -65,6 +66,7 @@ def test_moe_dp2_ep2_mp2_matches_dense():
     np.testing.assert_allclose(got, ref, rtol=1e-4)
 
 
+@pytest.mark.slow      # deep-combo compile cost; tier-1 keeps a cheap representative
 def test_moe_pp2_ep2_matches_dense():
     cfg = _cfg_nodrop()
     tok, lab = _data(cfg)
@@ -76,6 +78,7 @@ def test_moe_pp2_ep2_matches_dense():
     np.testing.assert_allclose(got, ref, rtol=1e-4)
 
 
+@pytest.mark.slow      # deep-combo compile cost; tier-1 keeps a cheap representative
 def test_moe_full_hybrid_dp_pp_ep_zero2_remat():
     cfg = _cfg_nodrop()
     tok, lab = _data(cfg)
@@ -163,6 +166,7 @@ def test_dispatch_matches_reference_dense_formulation():
                                atol=1e-5)
 
 
+@pytest.mark.slow      # deep-combo compile cost; tier-1 keeps a cheap representative
 def test_moe_interleaved_pp_ep_matches_dense():
     """vpp x pp x ep: expert axis lands on dim 3 after the vpp chunk reshape."""
     from paddle_tpu.models.gpt import GPTConfig
@@ -179,6 +183,7 @@ def test_moe_interleaved_pp_ep_matches_dense():
     np.testing.assert_allclose(got, ref, rtol=1e-4)
 
 
+@pytest.mark.slow      # deep-combo compile cost; tier-1 keeps a cheap representative
 def test_moe_with_cp_and_pp_matches_dense():
     """MoE (dense dispatch per cp shard) under cp x pp: parity incl. the
     aux-loss scale (psum over cp averaged back)."""
