@@ -1,14 +1,22 @@
 """Serving benchmark: continuous-batching engine throughput under a Poisson
-request stream (ref vLLM benchmark_serving; Orca iteration-level scheduling).
+request stream (ref vLLM benchmark_serving; Orca iteration-level scheduling;
+Sarathi chunked prefill; vLLM prefix caching).
 
 Prints ONE JSON line: {"metric", "value", "unit", "requests", "decode_iters",
-"decode_executables", "prefill_executables", "buckets"}.
+"decode_executables", "prefill_executables", "ttft_p50_ms", "ttft_p99_ms",
+"prefix_hit_rate", ...}.
 
 TPU: GPT-3 1.3B shape at bf16, 32-slot engine, 64 mixed-length requests drawn
 from a Poisson arrival process.  CPU smoke (CI tier-1): `gpt_tiny`, 32
 requests, <10 s — same scheduler/paging code paths, asserting the compiled
-executable bound (1 decode + <= #buckets prefill programs) that makes
-continuous batching viable on TPU in the first place.
+executable bound (1 decode + bounded prefill programs) that makes continuous
+batching viable on TPU in the first place.
+
+`--shared-prefix-frac F` gives a fraction F of requests a common system-style
+prompt prefix so the prefix cache has something to hit — the win shows up as
+`prefilled_tokens` dropping while `prefix_hit_rate` rises.  `--prefill-chunk
+N` switches to Sarathi chunked prefill (prefill executable count collapses to
+1-2 regardless of prompt-length spread).
 """
 from __future__ import annotations
 
@@ -20,14 +28,17 @@ import numpy as np
 
 def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
                     page_size=8, max_model_len=None, max_new_tokens=8,
-                    request_rate=float("inf"), seed=0, params=None):
+                    request_rate=float("inf"), seed=0, params=None,
+                    prefill_chunk=None, prefix_cache=True,
+                    shared_prefix_frac=0.0):
     """Replay a Poisson request stream through LLMEngine; returns the metrics
     dict (also the CI smoke entrypoint — tests assert on the executable
-    counts).  request_rate=inf enqueues everything up front (offline batch
-    throughput); a finite rate interleaves arrivals with engine steps.
-    """
+    counts and the prefix-cache hit rate).  request_rate=inf enqueues
+    everything up front (offline batch throughput); a finite rate interleaves
+    arrivals with engine steps.  shared_prefix_frac gives that fraction of
+    requests one common prompt prefix (~half the max prompt length, not
+    page-aligned so the copy-on-write path is exercised too)."""
     import jax
-    import jax.numpy as jnp
 
     from paddle_tpu.inference.engine import LLMEngine
     from paddle_tpu.models import gpt as gpt_mod
@@ -39,41 +50,77 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
     max_model_len = max_model_len or config.max_seq_len
 
     eng = LLMEngine(params, config, num_slots=num_slots, page_size=page_size,
-                    max_model_len=max_model_len)
+                    max_model_len=max_model_len, prefill_chunk=prefill_chunk,
+                    prefix_cache=prefix_cache)
     rng = np.random.RandomState(seed)
     max_prompt = max_model_len - max_new_tokens
+    shared = None
+    if shared_prefix_frac > 0.0:
+        shared_len = min(max_prompt - 1,
+                         max(page_size + page_size // 2, max_prompt // 2))
+        shared = rng.randint(0, config.vocab_size, (shared_len,)).astype(np.int32)
     lens = rng.randint(1, max_prompt + 1, size=num_requests)
-    prompts = [rng.randint(0, config.vocab_size, (n,)).astype(np.int32)
-               for n in lens]
+    prompts = []
+    for n in lens:
+        if shared is not None and rng.rand() < shared_prefix_frac:
+            # 1 in 4 shared-prefix requests IS the bare prefix: completing it
+            # registers its final partial page, so later extensions hit the
+            # copy-on-write partial-page path, not just whole-page sharing
+            tail = 0 if rng.rand() < 0.25 else \
+                rng.randint(1, max_prompt - shared.size + 1)
+            prompts.append(np.concatenate(
+                [shared, rng.randint(0, config.vocab_size, (tail,))
+                 .astype(np.int32)]) if tail else shared.copy())
+        else:
+            prompts.append(rng.randint(0, config.vocab_size, (n,))
+                           .astype(np.int32))
     # Poisson process: exponential inter-arrival gaps at `request_rate` req/s
     gaps = (rng.exponential(1.0 / request_rate, size=num_requests)
             if np.isfinite(request_rate) else np.zeros(num_requests))
     arrivals = np.cumsum(gaps)
 
-    # warmup: compile the decode executable + every REACHABLE prefill bucket
-    # once so the timed section measures steady-state serving, not compilation
-    # (a bucket past max_prompt is still reachable by shorter prompts, so warm
-    # it with the longest admissible prompt that maps to it)
-    for n in sorted({min(b, max_prompt) for b in eng.buckets}):
-        eng.add_request(np.zeros((n,), np.int32), max_new_tokens=1)
+    # warmup: compile every executable the timed section can reach so it
+    # measures steady-state serving, not compilation.  Random (non-shared)
+    # prompts keep the prefix cache out of bucket warmup; the identical pair
+    # at the end compiles the chunk-tail + COW page-copy executables.
+    wrng = np.random.RandomState(seed + 1)
+    if prefill_chunk is None:
+        # one prompt per reachable bucket (a bucket past max_prompt is still
+        # reachable by shorter prompts — warm it with the longest admissible)
+        for n in sorted({min(b, max_prompt) for b in eng.buckets}):
+            eng.add_request(wrng.randint(0, config.vocab_size, (n,))
+                            .astype(np.int32), max_new_tokens=1)
+    else:
+        n = min(max_prompt, prefill_chunk * 2 + 1)  # chunk + remainder path
+        eng.add_request(wrng.randint(0, config.vocab_size, (n,))
+                        .astype(np.int32), max_new_tokens=1)
     eng.run()
+    if prefix_cache:
+        lp = min(max_prompt - 2, page_size + page_size // 2 + 1)
+        pair = wrng.randint(0, config.vocab_size, (lp + 2,)).astype(np.int32)
+        eng.add_request(pair[:lp], max_new_tokens=1)
+        eng.run()                       # donor registers its prompt pages
+        eng.add_request(pair, max_new_tokens=1)
+        eng.run()                       # extension: full-page share + COW
+    eng.reset_counters()
 
     t0 = time.perf_counter()
     pending = list(zip(arrivals, prompts))
-    done = 0
+    outs = []
     while pending or eng.has_work:
         now = time.perf_counter() - t0
         while pending and pending[0][0] <= now:
             _, p = pending.pop(0)
             eng.add_request(p, max_new_tokens=max_new_tokens)
         if eng.has_work:
-            done += len(eng.step())
+            outs.extend(eng.step())
         elif pending:
             time.sleep(min(pending[0][0] - now, 0.01))
     dt = time.perf_counter() - t0
-    assert done == num_requests, (done, num_requests)
+    assert len(outs) == num_requests, (len(outs), num_requests)
 
     st = eng.stats()
+    ttft = np.asarray([o.ttft_s for o in outs if o.ttft_s is not None])
     # ACTIVE decode tokens only — idle slots in ramp-up/drain iterations are
     # not useful work and would overstate throughput at low arrival rates
     decode_tokens = st["decode_tokens"]
@@ -83,32 +130,67 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
         "generated_tokens_per_sec": round(num_requests * max_new_tokens / dt, 1),
         "requests": num_requests,
         "elapsed_s": round(dt, 3),
+        "ttft_p50_ms": round(float(np.percentile(ttft, 50)) * 1e3, 2),
+        "ttft_p99_ms": round(float(np.percentile(ttft, 99)) * 1e3, 2),
+        "prefix_hit_rate": round(st["prefix_hit_rate"], 4),
+        "prefix_cached_tokens": st["prefix_cached_tokens"],
+        "prefilled_tokens": st["prefilled_tokens"],
+        "cow_page_copies": st["cow_page_copies"],
+        "prefix_evictions": st["prefix_evictions"],
         "decode_iters": st["decode_iterations"],
+        "prefill_chunks": st["prefill_chunks"],
         "decode_executables": st["decode_executables"],
         "prefill_executables": st["prefill_executables"],
+        "copy_executables": st["copy_executables"],
         "buckets": st["buckets"],
+        "prefill_chunk": prefill_chunk,
+        "shared_prefix_frac": shared_prefix_frac,
         "kv_token_capacity": st["kv_token_capacity"],
         "dense_token_footprint": st["dense_token_footprint"],
     }
 
 
 def main():
+    import argparse
+
     import jax
     import jax.numpy as jnp
 
     from paddle_tpu.models.gpt import GPTConfig
 
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--shared-prefix-frac", type=float, default=0.0,
+                    help="fraction of requests sharing a common prompt prefix")
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    help="Sarathi chunked prefill with this chunk length "
+                         "(default: bucketed one-shot prefill)")
+    ap.add_argument("--no-prefix-cache", action="store_true",
+                    help="disable copy-on-write prefix page sharing")
+    ap.add_argument("--request-rate", type=float, default=None,
+                    help="Poisson arrival rate in req/s (default: offline)")
+    args = ap.parse_args()
+    if args.request_rate is not None and args.request_rate <= 0:
+        ap.error("--request-rate must be > 0")
+
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    kw = dict(prefill_chunk=args.prefill_chunk,
+              prefix_cache=not args.no_prefix_cache,
+              shared_prefix_frac=args.shared_prefix_frac)
     if on_tpu:
         config = GPTConfig(vocab_size=50304, hidden_size=2048, num_layers=24,
                            num_heads=16, max_seq_len=2048, dtype=jnp.bfloat16)
         stats = run_serve_bench(config, num_requests=64, num_slots=32,
                                 page_size=16, max_model_len=1024,
-                                max_new_tokens=64, request_rate=16.0)
+                                max_new_tokens=64,
+                                request_rate=16.0 if args.request_rate is None
+                                else args.request_rate, **kw)
         metric = "serve_decode_tokens_per_sec_per_chip"
     else:  # CI smoke: tiny config, same scheduler/paging code paths
         stats = run_serve_bench(num_requests=32, num_slots=4, page_size=8,
-                                max_model_len=64, max_new_tokens=6)
+                                max_model_len=64, max_new_tokens=6,
+                                request_rate=float("inf") if args.request_rate is None
+                                else args.request_rate,
+                                **kw)
         metric = "serve_decode_tokens_per_sec (cpu smoke)"
     print(json.dumps({"metric": metric,
                       "value": stats["decode_tokens_per_sec_per_chip"],

@@ -21,6 +21,14 @@ attention then has to read each slot's keys/values *through* the page table:
 Layout note: one query token per slot (`q [B, H, hd]`) — decode T=1 is the hot
 case the engine compiles once.  GQA folds into the kernel as G = H // KVH query
 rows per kv head.
+
+Chunked prefill (Sarathi-Serve, Agrawal et al. OSDI 2024) adds the
+`*_prefill_*` pair: a chunk of T query tokens starting at position
+`q_offset != 0` attends through the same page table with the causal mask
+`kv_pos <= q_offset + t` — positions below the offset are the already-written
+prefix (cached pages or earlier chunks), positions inside the chunk mask
+causally.  The `q_offset` lane rides the scalar prefetch next to the page
+table in the Pallas kernel and is a broadcast add in the XLA oracle.
 """
 from __future__ import annotations
 
@@ -166,6 +174,164 @@ def paged_attention_pallas(q, k_pages, v_pages, page_table, lengths,
         interpret=interpret,
     )(jnp.asarray(page_table, jnp.int32), jnp.asarray(lengths, jnp.int32),
       q, k_pages, v_pages)
+
+
+def paged_prefill_attention_xla(q, k_pages, v_pages, page_table, q_offset,
+                                valid, scale=None):
+    """Gather-based chunked-prefill paged attention (fallback + oracle).
+
+    q: [B, T, H, hd] — a chunk of T query tokens per slot; query t sits at
+        absolute position q_offset[b] + t.
+    k_pages/v_pages: [P, page_size, KVH, hd] — the page pool for one layer.
+    page_table: [B, max_pages] int32 page ids (0 = reserved null page).
+    q_offset: [B] int32 — absolute position of q[:, 0] (prefix already
+        written below it: cached pages or earlier chunks).
+    valid: [B] int32 — real tokens in the chunk; rows t >= valid[b] compute
+        garbage the caller ignores (their KV was routed to the null page).
+    Returns [B, T, H, hd].
+    """
+    B, T, H, hd = q.shape
+    page = k_pages.shape[1]
+    KVH = k_pages.shape[2]
+    G = H // KVH
+    S = page_table.shape[1] * page
+    s = scale if scale is not None else 1.0 / math.sqrt(hd)
+    k = k_pages[page_table].reshape(B, S, KVH, hd)
+    v = v_pages[page_table].reshape(B, S, KVH, hd)
+    qg = q.reshape(B, T, KVH, G, hd)
+    logits = jnp.einsum("btkgd,bskd->bkgts", qg, k,
+                        preferred_element_type=jnp.float32) * s
+    qpos = q_offset[:, None] + jnp.arange(T)                    # [B, T]
+    mask = jnp.arange(S)[None, None] <= qpos[:, :, None]        # [B, T, S]
+    logits = jnp.where(mask[:, None, None], logits, NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgts,bskd->bkgtd", p.astype(v.dtype), v)
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, T, H, hd)
+
+
+def _paged_prefill_kernel(tbl_ref, qoff_ref, val_ref, q_ref, k_ref, v_ref,
+                          o_ref, acc_ref, m_ref, l_ref, *, page: int,
+                          KVH: int, G: int, T: int, n_pages: int,
+                          scale: float):
+    """Grid (B, max_pages): slots parallel, pages innermost with
+    online-softmax scratch carry over T*H query rows (kh-major stacking, same
+    discipline as the decode kernel).  The causal-at-offset mask
+    `kv_pos <= q_offset + t` replaces the decode kernel's length mask; page 0
+    always computes (every query row attends at least to kv position 0), so
+    the running max is finite before any fully-masked row/page combination."""
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    H = KVH * G
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    qoff = qoff_ref[b]
+    last_q = qoff + val_ref[b] - 1      # highest real query position
+    k_start = j * page
+
+    # page entirely past every real query position: skip compute
+    @pl.when(k_start <= last_q)
+    def _compute():
+        q = q_ref[0]                                    # [T, H, hd]
+        k = k_ref[0]                                    # [page, KVH, hd]
+        v = v_ref[0]
+        rows = []
+        for kh in range(KVH):
+            qh = q[:, kh * G:(kh + 1) * G, :].reshape(T * G, -1)
+            rows.append(jnp.dot(qh, k[:, kh, :].T,
+                                preferred_element_type=jnp.float32))
+        s = (jnp.concatenate(rows, axis=0) if KVH > 1 else rows[0]) * scale
+        R = KVH * T * G
+        kv_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (R, page), 1)
+        t_row = (jax.lax.broadcasted_iota(jnp.int32, (R, page), 0)
+                 % (T * G)) // G
+        s = jnp.where(kv_pos <= qoff + t_row, s, NEG_INF)
+        m_prev = m_ref[...]
+        l_prev = l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                          # [R, page]
+        l_ref[...] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        upd = []
+        for kh in range(KVH):
+            ph = p[kh * T * G:(kh + 1) * T * G].astype(v.dtype)
+            upd.append(jnp.dot(ph, v[:, kh, :],
+                               preferred_element_type=jnp.float32))
+        pv = jnp.concatenate(upd, axis=0) if KVH > 1 else upd[0]   # [R, hd]
+        acc_ref[...] = acc_ref[...] * corr + pv
+        m_ref[...] = m_new
+
+    @pl.when(j == n_pages - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[...], 1e-30)
+        out = acc_ref[...] / l                          # [KVH*T*G, hd]
+        for kh in range(KVH):
+            blk = out[kh * T * G:(kh + 1) * T * G].reshape(T, G, -1)
+            o_ref[0, :, kh * G:(kh + 1) * G, :] = blk.astype(o_ref.dtype)
+
+
+def paged_prefill_attention_pallas(q, k_pages, v_pages, page_table, q_offset,
+                                   valid, scale=None, interpret=False):
+    """Pallas chunked-prefill paged attention — same contract as
+    `paged_prefill_attention_xla`.  page_table / q_offset / valid ride
+    `PrefetchScalarGridSpec`; `interpret=True` runs on CPU for numerics
+    tests."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T, H, hd = q.shape
+    page = k_pages.shape[1]
+    KVH = k_pages.shape[2]
+    G = H // KVH
+    n_pages = page_table.shape[1]
+    s = scale if scale is not None else 1.0 / math.sqrt(hd)
+
+    kernel = functools.partial(_paged_prefill_kernel, page=page, KVH=KVH,
+                               G=G, T=T, n_pages=n_pages, scale=s)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,          # (page_table, q_offset, valid)
+        grid=(B, n_pages),
+        in_specs=[
+            pl.BlockSpec((1, T, H, hd), lambda b, j, tbl, qo, vl: (b, 0, 0, 0)),
+            pl.BlockSpec((1, page, KVH, hd),
+                         lambda b, j, tbl, qo, vl: (tbl[b, j], 0, 0, 0)),
+            pl.BlockSpec((1, page, KVH, hd),
+                         lambda b, j, tbl, qo, vl: (tbl[b, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, T, H, hd),
+                               lambda b, j, tbl, qo, vl: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((KVH * T * G, hd), jnp.float32),
+            pltpu.VMEM((KVH * T * G, 1), jnp.float32),
+            pltpu.VMEM((KVH * T * G, 1), jnp.float32),
+        ],
+    )
+    cparams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, T, H, hd), q.dtype),
+        compiler_params=cparams(dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(jnp.asarray(page_table, jnp.int32), jnp.asarray(q_offset, jnp.int32),
+      jnp.asarray(valid, jnp.int32), q, k_pages, v_pages)
+
+
+def paged_prefill_attention(q, k_pages, v_pages, page_table, q_offset, valid,
+                            scale=None):
+    """Entry used by `models.gpt.prefill_chunk_paged`: Pallas on TPU when the
+    layout is kernel-friendly, gather fallback otherwise."""
+    if _on_tpu() and _shapes_ok_for_pallas(q, k_pages):
+        return paged_prefill_attention_pallas(q, k_pages, v_pages, page_table,
+                                              q_offset, valid, scale=scale)
+    return paged_prefill_attention_xla(q, k_pages, v_pages, page_table,
+                                       q_offset, valid, scale=scale)
 
 
 def _shapes_ok_for_pallas(q, k_pages):

@@ -1,10 +1,11 @@
 """Paged KV cache manager (ref vLLM block manager, Kwon et al. SOSP 2023).
 
 Host-side page accounting for the serving engine: a free list over a static
-device pool (`models.gpt.init_paged_cache`), per-slot page-table rows, and
-per-slot lengths.  All methods are O(pages) host operations — the device only
-ever sees the fixed-shape `[num_slots, max_pages_per_slot]` table and
-`[num_slots]` lengths, so the compiled decode step never changes shape.
+device pool (`models.gpt.init_paged_cache`), per-slot page-table rows,
+per-slot lengths, per-page refcounts, and a content-hash prefix index.  All
+methods are O(pages) host operations — the device only ever sees the
+fixed-shape `[num_slots, max_pages_per_slot]` table and `[num_slots]`
+lengths, so the compiled decode step never changes shape.
 
 Allocation is reservation-based: a request's full footprint
 (prompt + max_new_tokens, rounded up to pages) is reserved at admission, so a
@@ -12,17 +13,49 @@ running sequence can never hit out-of-pages mid-decode (preemption/swapping is
 an open item, see ROADMAP).  Page 0 is reserved as the null page: unreserved
 table entries point at it, inactive slots write to it, and attention masking
 by length guarantees it is never read.
+
+Prefix cache (vLLM copy-on-write page sharing): prompt pages whose KV has
+been fully written are registered in a trie-shaped index keyed by
+(parent node, token bytes) — i.e. by the token-id *content* of the whole
+prefix up to that page.  A later request whose prompt shares a page-aligned
+prefix maps the cached pages read-only into its table row (refcount++) and
+only prefills the tail; a matched *partial* final page is shared
+copy-on-write: the caller copies the page on device into a fresh page the
+new slot owns before appending into it.  Pages are freed only when their
+refcount returns to 0; registered pages at refcount 0 park in an LRU of
+evictable prefixes and are reclaimed on demand, so cached prefixes can never
+deadlock the pool.
 """
 from __future__ import annotations
+
+import dataclasses
+import itertools
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 NULL_PAGE = 0
 
 
+@dataclasses.dataclass
+class _PrefixNode:
+    """One cached page of prompt KV: `page` holds the KV of `n_tokens` tokens
+    whose identity (and that of the whole preceding prefix) is pinned by
+    `key = (parent node id, token bytes)`.  n_tokens == page_size for full
+    pages; a smaller n marks a partial page, shareable only via COW."""
+    node_id: int
+    key: Tuple[int, bytes]
+    page: int
+    n_tokens: int
+
+
+_ROOT = 0   # parent id of first-page nodes
+
+
 class PagedKVCache:
-    """Page-table + free-list bookkeeping for `num_slots` decode slots over a
-    pool of `num_pages` pages of `page_size` tokens each."""
+    """Page-table + free-list + prefix-index bookkeeping for `num_slots`
+    decode slots over a pool of `num_pages` pages of `page_size` tokens."""
 
     def __init__(self, num_pages: int, page_size: int, num_slots: int,
                  max_pages_per_slot: int):
@@ -39,51 +72,220 @@ class PagedKVCache:
         self.page_table = np.full((num_slots, max_pages_per_slot), NULL_PAGE,
                                   np.int32)
         self.lengths = np.zeros((num_slots,), np.int32)
-        self._used = {s: [] for s in range(num_slots)}
+        self._used: Dict[int, List[int]] = {s: [] for s in range(num_slots)}
+        self._ref = np.zeros((num_pages,), np.int64)
+        # prefix index: key -> node; page -> node; LRU of refcount-0 nodes
+        self._index: Dict[Tuple[int, bytes], _PrefixNode] = {}
+        self._page_node: Dict[int, _PrefixNode] = {}
+        self._lru: "OrderedDict[int, _PrefixNode]" = OrderedDict()
+        self._node_ids = itertools.count(1)
+        self.prefix_evictions = 0
 
     # ---- capacity queries -------------------------------------------------
     @property
     def num_free_pages(self) -> int:
+        """Pages immediately allocatable without evicting cached prefixes."""
         return len(self._free)
+
+    @property
+    def num_evictable_pages(self) -> int:
+        """Registered prefix pages at refcount 0 — reclaimable on demand."""
+        return len(self._lru)
 
     def pages_needed(self, total_tokens: int) -> int:
         return -(-total_tokens // self.page_size)
 
-    def can_allocate(self, total_tokens: int) -> bool:
+    def can_allocate(self, total_tokens: int,
+                     tokens: Optional[np.ndarray] = None) -> bool:
+        """Whether a `total_tokens` footprint fits, counting evictable cached
+        pages and (when the prompt `tokens` are given) pages the prefix cache
+        would share instead of allocating fresh."""
         n = self.pages_needed(total_tokens)
-        return n <= len(self._free) and n <= self.max_pages_per_slot
+        if n > self.max_pages_per_slot:
+            return False
+        fresh = n
+        in_lru = 0
+        if tokens is not None:
+            full, partial = self._match(np.asarray(tokens, np.int32))
+            fresh = n - len(full)
+            for node in full:
+                if self._ref[node.page] == 0:
+                    in_lru += 1         # shared, so not evictable for us
+            if partial is not None and self._ref[partial.page] == 0:
+                in_lru += 1             # COW source must survive the copy
+        return fresh <= len(self._free) + len(self._lru) - in_lru
 
     def token_capacity(self) -> int:
         """Pool capacity in tokens (excludes the null page) — the number the
         engine's memory claim is measured against (vs num_slots * max_len)."""
         return (self.num_pages - 1) * self.page_size
 
+    # ---- prefix index -----------------------------------------------------
+    def _match(self, tokens: np.ndarray
+               ) -> Tuple[List[_PrefixNode], Optional[_PrefixNode]]:
+        """Longest cached prefix of `tokens`, capped at len(tokens) - 1 so at
+        least one position is always recomputed (its logits seed generation).
+        Returns (full-page nodes, optional partial-page node extending them)."""
+        page = self.page_size
+        lp = tokens.size
+        full: List[_PrefixNode] = []
+        parent = _ROOT
+        for i in range((lp - 1) // page):
+            node = self._index.get((parent, tokens[i * page:(i + 1) * page]
+                                    .tobytes()))
+            if node is None:
+                break
+            full.append(node)
+            parent = node.node_id
+        base = len(full) * page
+        partial = None
+        for j in range(min(lp - base - 1, page - 1), 0, -1):
+            node = self._index.get((parent, tokens[base:base + j].tobytes()))
+            if node is not None:
+                partial = node
+                break
+        return full, partial
+
+    def register_prefix(self, slot: int, tokens: np.ndarray,
+                        filled: int) -> None:
+        """Publish `slot`'s prompt pages whose KV is complete (the first
+        `filled` of `tokens`) into the prefix index.  Idempotent — call after
+        every prefill chunk; already-indexed keys (including pages this slot
+        itself shares) are left untouched, so duplicate concurrent prompts
+        simply keep their private pages unregistered.  The final partial page
+        is registered only once the whole prompt is in (filled == len) — its
+        content hash must cover exactly the prompt tail, and the slot keeps
+        appending decode tokens past it (harmless: the node only ever claims
+        the first n_tokens of the page; COW borrowers overwrite the rest)."""
+        tokens = np.asarray(tokens, np.int32)
+        page = self.page_size
+        pages = self._used[slot]
+        parent = _ROOT
+        for i in range(min(filled, tokens.size) // page):
+            key = (parent, tokens[i * page:(i + 1) * page].tobytes())
+            node = self._index.get(key)
+            if node is None and pages[i] not in self._page_node:
+                node = _PrefixNode(next(self._node_ids), key, pages[i], page)
+                self._index[key] = node
+                self._page_node[pages[i]] = node
+            if node is None:        # page already published under another key
+                return
+            parent = node.node_id
+        rem = tokens.size % page
+        if rem and filled == tokens.size:
+            i = tokens.size // page
+            key = (parent, tokens[i * page:].tobytes())
+            if key not in self._index and pages[i] not in self._page_node:
+                node = _PrefixNode(next(self._node_ids), key, pages[i], rem)
+                self._index[key] = node
+                self._page_node[pages[i]] = node
+
+    def _evict(self, fresh_needed: int) -> None:
+        """Reclaim LRU unreferenced cached prefixes until `fresh_needed` pages
+        are on the free list (or the LRU runs dry)."""
+        while len(self._free) < fresh_needed and self._lru:
+            _, node = self._lru.popitem(last=False)
+            del self._index[node.key]
+            del self._page_node[node.page]
+            self._free.append(node.page)
+            self.prefix_evictions += 1
+
     # ---- slot lifecycle ---------------------------------------------------
     def allocate(self, slot: int, total_tokens: int) -> np.ndarray:
         """Reserve ceil(total_tokens / page_size) pages for `slot` and write
         them into its table row.  Returns the row (view)."""
+        row, _, _ = self.allocate_prefixed(slot, total_tokens, None)
+        return row
+
+    def allocate_prefixed(self, slot: int, total_tokens: int,
+                          tokens: Optional[np.ndarray] = None
+                          ) -> Tuple[np.ndarray, int, Optional[Tuple[int, int]]]:
+        """Reserve `slot`'s footprint, sharing the longest cached prefix of
+        the prompt `tokens` (when given) instead of allocating fresh pages.
+
+        Returns (table row view, matched_tokens, cow):
+        - matched_tokens: prompt tokens whose KV the slot starts with —
+          full shared pages (mapped read-only, refcount++) plus, when `cow`
+          is set, the tokens of a matched partial page;
+        - cow: (src_page, dst_page) the CALLER must copy on device before the
+          slot writes anything — dst is the slot's own fresh page at the
+          partial boundary, src a cached page it must not mutate.
+        """
         n = self.pages_needed(total_tokens)
-        if n > len(self._free):
-            raise RuntimeError(
-                f"out of KV pages: need {n}, free {len(self._free)}")
         if n > self.max_pages_per_slot:
             raise ValueError(
                 f"request footprint {total_tokens} tokens exceeds slot "
                 f"capacity {self.max_pages_per_slot * self.page_size}")
         if self._used[slot]:
             raise RuntimeError(f"slot {slot} already has pages")
-        pages = [self._free.pop() for _ in range(n)]
+        full: List[_PrefixNode] = []
+        partial = None
+        if tokens is not None:
+            full, partial = self._match(np.asarray(tokens, np.int32))
+        shared = []
+        for node in full:
+            if self._ref[node.page] == 0:
+                self._lru.pop(node.node_id, None)   # revive from evictable
+            self._ref[node.page] += 1
+            shared.append(node.page)
+        # pin the COW source for the duration of this allocation: it must not
+        # be evicted to satisfy our own fresh-page demand
+        if partial is not None and partial.node_id in self._lru:
+            self._lru.move_to_end(partial.node_id)
+            pinned = self._lru.pop(partial.node_id)
+        else:
+            pinned = None
+        fresh_needed = n - len(shared)
+        self._evict(fresh_needed)
+        if pinned is not None:
+            self._lru[pinned.node_id] = pinned
+        if fresh_needed > len(self._free):
+            for p in reversed(shared):              # roll back the sharing
+                self._ref[p] -= 1
+                if self._ref[p] == 0:
+                    self._lru[self._page_node[p].node_id] = self._page_node[p]
+            raise RuntimeError(
+                f"out of KV pages: need {fresh_needed}, "
+                f"free {len(self._free)}")
+        fresh = [self._free.pop() for _ in range(fresh_needed)]
+        for p in fresh:
+            self._ref[p] = 1
+        pages = shared + fresh
         self._used[slot] = pages
         self.page_table[slot, :] = NULL_PAGE
         self.page_table[slot, :n] = pages
-        return self.page_table[slot]
+        matched = len(shared) * self.page_size
+        cow = None
+        if partial is not None:
+            cow = (partial.page, fresh[0])
+            matched += partial.n_tokens
+        return self.page_table[slot], matched, cow
 
     def release(self, slot: int) -> None:
-        """Return a retired slot's pages to the free list."""
-        self._free.extend(reversed(self._used[slot]))
+        """Retire a slot: decrement its pages' refcounts; pages reaching 0 go
+        back to the free list, unless they are registered cached prefixes —
+        those park in the LRU and stay matchable until evicted."""
+        for p in reversed(self._used[slot]):
+            self._ref[p] -= 1
+            if self._ref[p] == 0:
+                node = self._page_node.get(p)
+                if node is not None:
+                    self._lru[node.node_id] = node
+                    self._lru.move_to_end(node.node_id)
+                else:
+                    self._free.append(p)
         self._used[slot] = []
         self.page_table[slot, :] = NULL_PAGE
         self.lengths[slot] = 0
 
     def pages_in_use(self) -> int:
-        return sum(len(p) for p in self._used.values())
+        """Distinct pages with refcount > 0 (cached-but-unreferenced prefixes
+        do not count — they are reclaimable)."""
+        return int((self._ref > 0).sum())
+
+    def prefix_stats(self) -> Dict[str, int]:
+        return {
+            "cached_pages": len(self._index),
+            "evictable_pages": len(self._lru),
+            "prefix_evictions": self.prefix_evictions,
+        }

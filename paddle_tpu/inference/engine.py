@@ -16,21 +16,35 @@ and Orca's iteration-level scheduling (Yu et al., OSDI 2022), under the same
 - **Slot-indexed decode** — ONE compiled decode program of fixed batch
   `num_slots` (`models.gpt.decode_step_paged`) serves a churning request set;
   retired slots are refilled without recompiling.
-- **Bucketed prefill** — prompts pad to power-of-2 length buckets, bounding
-  the prefill executable count to the bucket count; prefill writes straight
-  into the slot's reserved pages.
+- **Prefix cache** (vLLM copy-on-write page sharing) — prompt pages are
+  content-hashed at page granularity as their KV lands; admission maps the
+  longest cached page-aligned prefix read-only into the new slot's table
+  (refcount++), COW-copies a matched partial page (one jitted page-copy
+  executable), and only prefills the uncached tail.  Retired prefixes stay
+  matchable until LRU-evicted under pool pressure.
+- **Chunked prefill** (Sarathi-Serve, Agrawal et al. OSDI 2024) — prompts
+  prefill in fixed-size chunks through ONE compiled chunk executable
+  (`models.gpt.prefill_chunk_paged`, any q_offset), and `step()` interleaves
+  at most one chunk with each decode iteration: a 4k-token prompt no longer
+  stalls every decode slot for a whole bucket-padded pass, and the prefill
+  program count collapses from #buckets to <= 2.  The legacy bucketed
+  one-shot path (`prefill_paged`, power-of-2 buckets) remains the default for
+  uncached prompts when `prefill_chunk=None`.
 - **Scheduler** — each `step()` admits queued requests into free slots
-  (reservation-based page admission), runs one decode iteration over all
-  active slots, and retires finished sequences (EOS or max_new_tokens),
-  returning their pages to the free list.
+  (reservation-based page admission with prefix matching), advances at most
+  one prefill chunk, runs one decode iteration over all fully-prefilled
+  slots, and retires finished sequences (EOS or max_new_tokens), returning
+  their pages to the refcounted pool.
 
 `bench_serve.py` replays a Poisson request stream through this engine and
-reports decode tokens/s/chip + compiled-program counts.
+reports decode tokens/s/chip, TTFT percentiles, prefix-cache hit rate and
+compiled-program counts.
 """
 from __future__ import annotations
 
 import dataclasses
 import itertools
+import time
 from collections import deque
 from typing import Dict, List, Optional
 
@@ -48,6 +62,7 @@ class Request:
     prompt: np.ndarray
     max_new_tokens: int = 16
     request_id: int = -1
+    t_enqueue: float = 0.0
 
 
 @dataclasses.dataclass
@@ -55,7 +70,9 @@ class RequestOutput:
     request_id: int
     prompt: np.ndarray
     token_ids: List[int]            # generated tokens (prompt excluded)
-    finish_reason: str              # "stop" (EOS) | "length" (budget)
+    finish_reason: str              # "stop" (EOS) | "length" (budget) | "abort"
+    cached_tokens: int = 0          # prompt tokens served from the prefix cache
+    ttft_s: Optional[float] = None  # enqueue -> first generated token
 
     @property
     def tokens(self) -> np.ndarray:
@@ -70,6 +87,19 @@ class _Running:
     request: Request
     slot: int
     generated: List[int]
+    cached_tokens: int = 0
+    ttft_s: Optional[float] = None
+
+
+@dataclasses.dataclass
+class _Prefilling:
+    """A slot whose prompt KV is still landing: `filled` prompt tokens are in
+    pages (prefix-cache hits + completed chunks); the slot joins the decode
+    set only once filled == len(prompt)."""
+    request: Request
+    slot: int
+    filled: int
+    cached_tokens: int
 
 
 def _pow2_buckets(lo: int, hi: int) -> List[int]:
@@ -89,7 +119,13 @@ class LLMEngine:
     is half of the dense `num_slots * max_model_len` footprint — the paged
     cache's whole point is that this still serves full-length traffic as long
     as *live* tokens fit).  Greedy by default; temperature/top_k compile the
-    sampling variant of the same two executables.
+    sampling variant of the same executables.
+
+    `prefix_cache=True` shares prompt pages across requests copy-on-write;
+    `prefill_chunk=N` switches prompt processing from the bucketed one-shot
+    ladder to N-token chunks interleaved one-per-step with decode.  Both are
+    scheduler-level: the decode executable, page pool and table shapes are
+    identical in every mode.
     """
 
     def __init__(self, params, config: gpt_mod.GPTConfig, *,
@@ -97,6 +133,8 @@ class LLMEngine:
                  num_pages: Optional[int] = None,
                  max_model_len: Optional[int] = None,
                  prefill_buckets: Optional[List[int]] = None,
+                 prefill_chunk: Optional[int] = None,
+                 prefix_cache: bool = True,
                  eos_token_id: Optional[int] = None,
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  seed: int = 0):
@@ -127,11 +165,21 @@ class LLMEngine:
             if b % page_size or b > max_model_len:
                 raise ValueError(f"bucket {b} incompatible with page_size "
                                  f"{page_size} / max_model_len {max_model_len}")
+        if prefill_chunk is not None and not 1 <= prefill_chunk <= max_model_len:
+            raise ValueError(f"prefill_chunk {prefill_chunk} outside "
+                             f"[1, {max_model_len}]")
+        self.prefill_chunk = prefill_chunk
+        self.chunked = prefill_chunk is not None
+        # chunk width also serves prefix-hit tails in bucketed mode, where the
+        # largest bucket bounds any tail in one call
+        self._chunk = prefill_chunk if self.chunked else self.buckets[-1]
+        self.prefix_cache = prefix_cache
         self.cache = PagedKVCache(num_pages, page_size, num_slots,
                                   max_pages_per_slot)
         self._pool = gpt_mod.init_paged_cache(config, num_pages, page_size)
         self._queue: deque = deque()
         self._running: Dict[int, _Running] = {}
+        self._prefilling: Dict[int, _Prefilling] = {}   # slot -> state, FIFO
         self._free_slots = list(range(num_slots - 1, -1, -1))
         self._ids = itertools.count()
         self._key = jax.random.key(seed)
@@ -158,13 +206,38 @@ class LLMEngine:
             first, key = pick(logits, key)
             return first, pool, key
 
-        # pool donated: the step updates it in place instead of copying the
+        def chunk_impl(params, ids, pool, table, q_offset, valid, key):
+            logits, pool = gpt_mod.prefill_chunk_paged(params, ids, cfg, pool,
+                                                       table, q_offset, valid)
+            tok, key = pick(logits, key)
+            return tok, pool, key
+
+        def copy_impl(pool, src, dst):
+            # COW page copy: one [page, KVH, hd] slab per layer, src -> dst
+            return {n: a.at[:, dst].set(a[:, src]) for n, a in pool.items()}
+
+        # pool donated: each step updates it in place instead of copying the
         # whole page pool every iteration
         self._decode_fn = jax.jit(decode_impl, donate_argnums=(2,))
         self._prefill_fn = jax.jit(prefill_impl, donate_argnums=(2,))
+        self._chunk_fn = jax.jit(chunk_impl, donate_argnums=(2,))
+        self._copy_fn = jax.jit(copy_impl, donate_argnums=(0,))
         self._seen_buckets = set()
+        self._chunk_used = False
+        self._copy_used = False
+        self.reset_counters()
+
+    def reset_counters(self) -> None:
+        """Zero the throughput/prefix counters (stats(), not executables) —
+        benches call this after warmup so compile-time traffic is excluded."""
         self._decode_iters = 0
         self._decode_tokens = 0         # per-iteration ACTIVE slots summed
+        self._prefill_chunks = 0
+        self._prefilled_tokens = 0      # prompt tokens actually computed
+        self._prefix_cached_tokens = 0  # prompt tokens served from the cache
+        self._prefix_hit_requests = 0
+        self._cow_copies = 0
+        self.cache.prefix_evictions = 0
 
     # ---- request intake ---------------------------------------------------
     def add_request(self, prompt, max_new_tokens: int = 16) -> int:
@@ -174,7 +247,7 @@ class LLMEngine:
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, "
                              f"got {max_new_tokens}")
-        if prompt.size > self.buckets[-1]:
+        if not self.chunked and prompt.size > self.buckets[-1]:
             raise ValueError(f"prompt length {prompt.size} exceeds largest "
                              f"prefill bucket {self.buckets[-1]}")
         total = prompt.size + max_new_tokens
@@ -182,8 +255,46 @@ class LLMEngine:
             raise ValueError(f"prompt + max_new_tokens = {total} exceeds "
                              f"max_model_len {self.max_model_len}")
         rid = next(self._ids)
-        self._queue.append(Request(prompt, max_new_tokens, rid))
+        self._queue.append(Request(prompt, max_new_tokens, rid,
+                                   time.perf_counter()))
         return rid
+
+    def abort(self, request_id: int) -> bool:
+        """Cancel a queued or in-flight request and free/deref its pages
+        immediately (a stuck client no longer leaks its reservation until
+        max_new_tokens runs out).  Shared prefix pages are only
+        deref-counted; the request lands in the outputs map with
+        finish_reason="abort" and whatever tokens it had produced.  Returns
+        False when the id is unknown or already finished."""
+        for req in self._queue:
+            if req.request_id == request_id:
+                self._queue.remove(req)
+                self._finish_output(req, [], "abort", 0, None)
+                return True
+        for slot, st in list(self._prefilling.items()):
+            if st.request.request_id == request_id:
+                del self._prefilling[slot]
+                self.cache.release(slot)
+                self._free_slots.append(slot)
+                self._finish_output(st.request, [], "abort",
+                                    st.cached_tokens, None)
+                return True
+        for slot, seq in list(self._running.items()):
+            if seq.request.request_id == request_id:
+                del self._running[slot]
+                self.cache.release(slot)
+                self._free_slots.append(slot)
+                self._finish_output(seq.request, seq.generated, "abort",
+                                    seq.cached_tokens, seq.ttft_s)
+                return True
+        return False
+
+    def _finish_output(self, req: Request, token_ids: List[int], reason: str,
+                       cached: int, ttft: Optional[float]) -> RequestOutput:
+        out = RequestOutput(req.request_id, req.prompt, token_ids, reason,
+                            cached, ttft)
+        self._outputs[out.request_id] = out
+        return out
 
     def _bucket_for(self, n: int) -> int:
         for b in self.buckets:
@@ -193,11 +304,13 @@ class LLMEngine:
 
     # ---- scheduler --------------------------------------------------------
     def step(self) -> List[RequestOutput]:
-        """One engine iteration: admit + prefill queued requests into free
-        slots, then one decode step over every active slot.  Returns the
-        requests that finished this iteration."""
+        """One engine iteration: admit queued requests into free slots
+        (prefix-cache matching + page reservation), advance at most ONE
+        prefill chunk, then one decode step over every fully-prefilled slot.
+        Returns the requests that finished this iteration."""
         finished: List[RequestOutput] = []
         self._admit(finished)
+        self._prefill_tick(finished)
         if self._running:
             self._decode_iter(finished)
         return finished
@@ -207,39 +320,112 @@ class LLMEngine:
         while self._queue and self._free_slots:
             req = self._queue[0]
             total = req.prompt.size + req.max_new_tokens
-            if not mgr.can_allocate(total):
-                if not self._running and mgr.pages_in_use() == 0:
-                    # nothing will ever free: the footprint exceeds the pool
+            tokens = req.prompt if self.prefix_cache else None
+            slot = self._free_slots[-1]
+            try:
+                # one shot: the prefix match and the reservation happen in the
+                # same call (a failed attempt rolls its sharing back), instead
+                # of re-hashing the prompt in a can_allocate probe every step
+                row, matched, cow = mgr.allocate_prefixed(slot, total, tokens)
+            except RuntimeError:            # out of KV pages
+                if not self._running and not self._prefilling and \
+                        mgr.pages_in_use() == 0:
+                    # nothing will ever free: even with every cached prefix
+                    # evicted the footprint exceeds the pool
                     raise ValueError(
                         f"request {req.request_id} needs "
                         f"{mgr.pages_needed(total)} pages but the pool only "
                         f"has {mgr.num_pages - 1}; raise num_pages")
                 break                       # wait for pages to free up
             self._queue.popleft()
-            slot = self._free_slots.pop()
-            row = mgr.allocate(slot, total)
+            self._free_slots.pop()
+            if cow is not None:
+                # the matched partial page is shared: copy it into the slot's
+                # own page before anything is appended into it
+                src, dst = cow
+                self._pool = self._copy_fn(self._pool,
+                                           jnp.asarray(src, jnp.int32),
+                                           jnp.asarray(dst, jnp.int32))
+                self._cow_copies += 1
+                self._copy_used = True
+            if matched:
+                self._prefix_cached_tokens += matched
+                self._prefix_hit_requests += 1
             lp = req.prompt.size
-            bucket = self._bucket_for(lp)
-            ids = np.zeros((1, bucket), np.int32)
-            ids[0, :lp] = req.prompt
-            pages = row[:bucket // mgr.page_size][None, :]
-            first, self._pool, self._key = self._prefill_fn(
-                self.params, jnp.asarray(ids), self._pool,
-                jnp.asarray(pages), jnp.asarray([lp], jnp.int32), self._key)
-            self._seen_buckets.add(bucket)
-            mgr.lengths[slot] = lp
-            seq = _Running(req, slot, [int(np.asarray(first)[0])])
-            if not self._maybe_finish(seq, finished):
-                self._running[slot] = seq
+            if not self.chunked and matched == 0:
+                # legacy one-shot bucketed prefill, synchronous at admission
+                bucket = self._bucket_for(lp)
+                ids = np.zeros((1, bucket), np.int32)
+                ids[0, :lp] = req.prompt
+                pages = row[:bucket // mgr.page_size][None, :]
+                first, self._pool, self._key = self._prefill_fn(
+                    self.params, jnp.asarray(ids), self._pool,
+                    jnp.asarray(pages), jnp.asarray([lp], jnp.int32),
+                    self._key)
+                self._seen_buckets.add(bucket)
+                self._prefilled_tokens += lp
+                if self.prefix_cache:
+                    mgr.register_prefix(slot, req.prompt, lp)
+                self._start_decoding(req, slot, int(np.asarray(first)[0]), 0,
+                                     finished)
+            else:
+                self._prefilling[slot] = _Prefilling(req, slot, matched,
+                                                     matched)
+
+    def _prefill_tick(self, finished: List[RequestOutput]) -> None:
+        """Advance the oldest admitted prompt by ONE chunk (the Sarathi
+        interleave cap: long prompts share each iteration with decode instead
+        of stalling it)."""
+        if not self._prefilling:
+            return
+        slot, st = next(iter(self._prefilling.items()))
+        mgr = self.cache
+        lp = st.request.prompt.size
+        C = self._chunk
+        n = min(C, lp - st.filled)
+        ids = np.zeros((1, C), np.int32)
+        ids[0, :n] = st.request.prompt[st.filled:st.filled + n]
+        tok, self._pool, self._key = self._chunk_fn(
+            self.params, jnp.asarray(ids), self._pool,
+            jnp.asarray(mgr.page_table[slot][None, :]),
+            jnp.asarray([st.filled], jnp.int32), jnp.asarray([n], jnp.int32),
+            self._key)
+        self._chunk_used = True
+        self._prefill_chunks += 1
+        self._prefilled_tokens += n
+        st.filled += n
+        if self.prefix_cache:
+            mgr.register_prefix(slot, st.request.prompt, st.filled)
+        if st.filled == lp:
+            del self._prefilling[slot]
+            self._start_decoding(st.request, slot, int(np.asarray(tok)[0]),
+                                 st.cached_tokens, finished)
+
+    def _start_decoding(self, req: Request, slot: int, first: int,
+                        cached: int, finished: List[RequestOutput]) -> None:
+        """Prompt fully in pages + first token picked: join the decode set."""
+        self.cache.lengths[slot] = req.prompt.size
+        ttft = time.perf_counter() - req.t_enqueue
+        seq = _Running(req, slot, [first], cached, ttft)
+        if not self._maybe_finish(seq, finished):
+            self._running[slot] = seq
 
     def _decode_iter(self, finished: List[RequestOutput]) -> None:
         mgr = self.cache
         tokens = np.zeros((mgr.num_slots,), np.int32)
         for slot, seq in self._running.items():
             tokens[slot] = seq.generated[-1]
+        table = mgr.page_table
+        if self._prefilling:
+            # mid-prefill slots must look inactive to the decode executable:
+            # a null table row routes its (garbage) KV write to the null page
+            # instead of position lengths[slot]=0 of the slot's REAL first page
+            table = table.copy()
+            for slot in self._prefilling:
+                table[slot, :] = 0
         nxt, self._pool, self._key = self._decode_fn(
             self.params, jnp.asarray(tokens), self._pool,
-            jnp.asarray(mgr.page_table), jnp.asarray(mgr.lengths), self._key)
+            jnp.asarray(table), jnp.asarray(mgr.lengths), self._key)
         self._decode_iters += 1
         self._decode_tokens += len(self._running)
         nxt = np.asarray(nxt)
@@ -261,22 +447,21 @@ class LLMEngine:
             return False
         self.cache.release(seq.slot)
         self._free_slots.append(seq.slot)
-        out = RequestOutput(seq.request.request_id, seq.request.prompt,
-                            seq.generated, reason)
-        self._outputs[out.request_id] = out
+        out = self._finish_output(seq.request, seq.generated, reason,
+                                  seq.cached_tokens, seq.ttft_s)
         finished.append(out)
         return True
 
     def run(self) -> Dict[int, RequestOutput]:
         """Drain the queue: step until every request completes.  Returns
         {request_id: RequestOutput} for everything finished so far."""
-        while self._queue or self._running:
+        while self.has_work:
             self.step()
         return dict(self._outputs)
 
     @property
     def has_work(self) -> bool:
-        return bool(self._queue or self._running)
+        return bool(self._queue or self._running or self._prefilling)
 
     # ---- observability ----------------------------------------------------
     def stats(self) -> Dict[str, object]:
@@ -285,18 +470,34 @@ class LLMEngine:
                 return fn._cache_size()
             except Exception:
                 return fallback
+        cached, computed = self._prefix_cached_tokens, self._prefilled_tokens
         return {
             "decode_executables": execs(self._decode_fn,
                                         1 if self._decode_iters else 0),
             "prefill_executables": execs(self._prefill_fn,
-                                         len(self._seen_buckets)),
+                                         len(self._seen_buckets)) +
+                                   execs(self._chunk_fn,
+                                         1 if self._chunk_used else 0),
+            "copy_executables": execs(self._copy_fn,
+                                      1 if self._copy_used else 0),
             "buckets": list(self.buckets),
+            "prefill_chunk": self.prefill_chunk,
             "decode_iterations": self._decode_iters,
             "decode_tokens": self._decode_tokens,
+            "prefill_chunks": self._prefill_chunks,
+            "prefilled_tokens": computed,
+            "prefix_cached_tokens": cached,
+            "prefix_hit_requests": self._prefix_hit_requests,
+            "prefix_hit_rate": cached / (cached + computed)
+                               if cached + computed else 0.0,
+            "cow_page_copies": self._cow_copies,
             "pages_in_use": self.cache.pages_in_use(),
             "pages_free": self.cache.num_free_pages,
+            "pages_evictable": self.cache.num_evictable_pages,
+            "prefix_evictions": self.cache.prefix_evictions,
             "kv_token_capacity": self.cache.token_capacity(),
             "dense_token_footprint": self.cache.num_slots * self.max_model_len,
             "queued": len(self._queue),
+            "prefilling": len(self._prefilling),
             "running": len(self._running),
         }

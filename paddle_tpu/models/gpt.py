@@ -590,9 +590,20 @@ def _decode_qkv(bp, x, c: GPTConfig, pos):
     return q, k, v
 
 
-def _prefill_qkv(bp, x, c: GPTConfig):
-    """Pre-norm + packed qkv + rope over a [B, T, D] prompt (positions 0..T-1).
-    Returns post-rope q [B, T, H, hd], k, v [B, T, KVH, hd]."""
+def _rope_tables_at(config, pos):
+    """Rope sin/cos at explicit (possibly traced, per-batch) positions.
+    pos [B, T] int32 -> tables [B, T, head_dim/2] for apply_rope's batched
+    branch — the chunked-prefill path, whose chunk starts at q_offset != 0."""
+    D = config.head_dim
+    inv = 1.0 / (10000.0 ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
+    freqs = pos.astype(jnp.float32)[..., None] * inv
+    return jnp.sin(freqs), jnp.cos(freqs)
+
+
+def _prefill_qkv(bp, x, c: GPTConfig, pos=None):
+    """Pre-norm + packed qkv + rope over a [B, T, D] prompt (positions
+    0..T-1, or explicit per-batch positions `pos` [B, T] for chunked
+    prefill).  Returns post-rope q [B, T, H, hd], k, v [B, T, KVH, hd]."""
     B, T, _ = x.shape
     H, KVH, hd = c.num_heads, c.kv_heads, c.head_dim
     h = _norm(x, bp["ln1_w"], bp["ln1_b"], c) if c.norm_position == "pre" \
@@ -605,7 +616,7 @@ def _prefill_qkv(bp, x, c: GPTConfig):
     k = k.reshape(B, T, KVH, hd)
     v = v.reshape(B, T, KVH, hd)
     if c.use_rope:
-        sin, cos = _rope_tables(c, T)
+        sin, cos = _rope_tables(c, T) if pos is None else _rope_tables_at(c, pos)
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
     return q, k, v
@@ -811,6 +822,59 @@ def prefill_paged(params, input_ids, config: GPTConfig, cache, pages, length):
         lambda carry, inp: layer(carry, inp),
         x, (params["blocks"], cache["k"], cache["v"]))
     x = x[jnp.arange(B), length - 1]                 # last real position
+    x = epilogue(params, x, c)
+    return jnp.matmul(x, head_matrix(params, c)), {"k": new_k, "v": new_v}
+
+
+def prefill_chunk_paged(params, input_ids, config: GPTConfig, cache,
+                        page_table, q_offset, valid):
+    """Chunked paged prefill (Sarathi-style, Agrawal et al. OSDI 2024): one
+    dense pass over a fixed-size chunk of the prompt starting at position
+    q_offset, attending through the page table to everything already written
+    below it (prefix-cached pages and earlier chunks).  ONE compiled
+    executable serves every chunk of every prompt — q_offset, valid and the
+    page ids are all data, not shape.
+
+    input_ids [B, C] right-padded chunk; page_table [B, max_pages] the slot's
+    FULL table row; q_offset [B] int32 absolute position of input_ids[:, 0];
+    valid [B] int32 real tokens in the chunk (>= 1).  KV is written
+    token-granularly at page_table[(q_offset+t) // page][(q_offset+t) % page]
+    — unlike the bucketed `prefill_paged`'s whole-page writes, this never
+    clobbers the head of a copy-on-write page the chunk starts inside, and
+    padded tail tokens route to the reserved null page 0.  Returns
+    (logits [B, V] at chunk index valid-1 — the caller uses them only for the
+    final chunk — and the updated cache).
+    """
+    from ..incubate.kernels.paged_attention import paged_prefill_attention
+    c = config
+    assert c.causal, "KV-cache decoding requires a causal model"
+    B, C = input_ids.shape
+    D, H, KVH, hd = c.hidden_size, c.num_heads, c.kv_heads, c.head_dim
+    page = cache["k"].shape[2]
+    pos = q_offset[:, None] + jnp.arange(C)                  # [B, C]
+    real = jnp.arange(C)[None, :] < valid[:, None]           # [B, C]
+    x = jnp.take(params["wte"], input_ids, axis=0)
+    if not c.use_rope:
+        # jnp.take clips padded-tail positions past wpe; their rows are junk
+        # the scheduler never reads (valid-1 is always a real position)
+        x = x + jnp.take(params["wpe"], pos, axis=0)
+    pidx = jnp.take_along_axis(page_table, pos // page, axis=1)
+    pidx = jnp.where(real, pidx, 0)                          # pad -> null page
+    off = pos % page
+
+    def layer(x, layer_in):
+        bp, kc, vc = layer_in
+        q, k, v = _prefill_qkv(bp, x, c, pos=pos)
+        kc = kc.at[pidx, off].set(k)          # token-granular page scatter
+        vc = vc.at[pidx, off].set(v)
+        attn = paged_prefill_attention(q, kc, vc, page_table, q_offset, valid)
+        x = _layer_tail(bp, x, attn.reshape(B, C, D), c)
+        return x, (kc, vc)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        lambda carry, inp: layer(carry, inp),
+        x, (params["blocks"], cache["k"], cache["v"]))
+    x = x[jnp.arange(B), valid - 1]                  # last real chunk position
     x = epilogue(params, x, c)
     return jnp.matmul(x, head_matrix(params, c)), {"k": new_k, "v": new_v}
 
