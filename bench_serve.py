@@ -17,6 +17,18 @@ prompt prefix so the prefix cache has something to hit — the win shows up as
 `prefilled_tokens` dropping while `prefix_hit_rate` rises.  `--prefill-chunk
 N` switches to Sarathi chunked prefill (prefill executable count collapses to
 1-2 regardless of prompt-length spread).
+
+`--spec-len K` (default 4; `--no-spec` disables) turns on speculative
+decoding: n-gram self-drafting + one fixed-shape K+1-token verify executable.
+The win shows up as `accepted_per_step` (mean tokens emitted per drafted
+verify — 1.0 means drafts never helped) and the decode tokens/s delta vs the
+`--no-spec` pass that main() runs alongside for comparison; `spec_parity`
+confirms the two passes emitted byte-identical tokens (greedy acceptance is
+lossless whenever verify and decode logits agree at argmax — exact at
+matching kernel numerics; a TPU bf16 near-tie can in principle diverge).
+The decode and verify executables are compiled during warmup
+(`LLMEngine.warm_decode`/`warm_spec`) so the timed section measures
+steady-state serving.
 """
 from __future__ import annotations
 
@@ -30,14 +42,20 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
                     page_size=8, max_model_len=None, max_new_tokens=8,
                     request_rate=float("inf"), seed=0, params=None,
                     prefill_chunk=None, prefix_cache=True,
-                    shared_prefix_frac=0.0):
+                    shared_prefix_frac=0.0, spec_len=0):
     """Replay a Poisson request stream through LLMEngine; returns the metrics
     dict (also the CI smoke entrypoint — tests assert on the executable
-    counts and the prefix-cache hit rate).  request_rate=inf enqueues
-    everything up front (offline batch throughput); a finite rate interleaves
-    arrivals with engine steps.  shared_prefix_frac gives that fraction of
-    requests one common prompt prefix (~half the max prompt length, not
-    page-aligned so the copy-on-write path is exercised too)."""
+    counts, the prefix-cache hit rate and the speculative acceptance rate).
+    request_rate=inf enqueues everything up front (offline batch throughput);
+    a finite rate interleaves arrivals with engine steps.  shared_prefix_frac
+    gives that fraction of requests one common prompt prefix (~half the max
+    prompt length, not page-aligned so the copy-on-write path is exercised
+    too).  spec_len > 0 enables n-gram speculative decoding; the returned
+    `outputs_digest` hashes every request's generated tokens in request-id
+    order, so spec-on and spec-off passes over the same stream can assert
+    exact greedy parity."""
+    import hashlib
+
     import jax
 
     from paddle_tpu.inference.engine import LLMEngine
@@ -51,7 +69,7 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
 
     eng = LLMEngine(params, config, num_slots=num_slots, page_size=page_size,
                     max_model_len=max_model_len, prefill_chunk=prefill_chunk,
-                    prefix_cache=prefix_cache)
+                    prefix_cache=prefix_cache, spec_len=spec_len)
     rng = np.random.RandomState(seed)
     max_prompt = max_model_len - max_new_tokens
     shared = None
@@ -102,6 +120,12 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
         eng.run()                       # donor registers its prompt pages
         eng.add_request(pair, max_new_tokens=1)
         eng.run()                       # extension: full-page share + COW
+    # 1-token warmup requests pick their token at prefill and retire without
+    # ever dispatching decode or verify — warm those two explicitly so their
+    # compiles stay out of the timed section (the spec on/off ratio would
+    # otherwise compare a compile-laden pass against a compile-light one)
+    eng.warm_decode()
+    eng.warm_spec()                     # verify executable (no-op spec off)
     eng.reset_counters()
 
     t0 = time.perf_counter()
@@ -121,9 +145,17 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
 
     st = eng.stats()
     ttft = np.asarray([o.ttft_s for o in outs if o.ttft_s is not None])
-    # ACTIVE decode tokens only — idle slots in ramp-up/drain iterations are
+    # EMITTED decode tokens only — idle slots in ramp-up/drain iterations are
     # not useful work and would overstate throughput at low arrival rates
+    # (with spec on, an accepted draft emits several tokens per slot-step)
     decode_tokens = st["decode_tokens"]
+    digest = hashlib.sha256()
+    for o in sorted(outs, key=lambda o: o.request_id):
+        # id + length delimit each stream: tokens redistributed across
+        # request boundaries must not collide to the same digest
+        digest.update(np.asarray([o.request_id, len(o.token_ids)],
+                                 np.int64).tobytes())
+        digest.update(np.asarray(o.token_ids, np.int64).tobytes())
     n_chips = max(1, len(jax.devices()))
     return {
         "decode_tokens_per_sec_per_chip": round(decode_tokens / dt / n_chips, 1),
@@ -140,11 +172,18 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
         "decode_iters": st["decode_iterations"],
         "prefill_chunks": st["prefill_chunks"],
         "decode_executables": st["decode_executables"],
+        "verify_executables": st["verify_executables"],
         "prefill_executables": st["prefill_executables"],
         "copy_executables": st["copy_executables"],
         "buckets": st["buckets"],
         "prefill_chunk": prefill_chunk,
         "shared_prefix_frac": shared_prefix_frac,
+        "spec_len": spec_len,
+        "verify_steps": st["verify_steps"],
+        "accepted_per_step": round(st["accepted_per_step"], 3),
+        "spec_drafted_tokens": st["spec_drafted_tokens"],
+        "spec_accepted_tokens": st["spec_accepted_tokens"],
+        "outputs_digest": digest.hexdigest(),
         "kv_token_capacity": st["kv_token_capacity"],
         "dense_token_footprint": st["dense_token_footprint"],
     }
@@ -166,11 +205,20 @@ def main():
                          "(default: bucketed one-shot prefill)")
     ap.add_argument("--no-prefix-cache", action="store_true",
                     help="disable copy-on-write prefix page sharing")
+    ap.add_argument("--spec-len", type=int, default=4,
+                    help="speculative decoding draft length (n-gram "
+                         "self-drafting + one K+1-token verify executable)")
+    ap.add_argument("--no-spec", action="store_true",
+                    help="disable speculative decoding (also skips the "
+                         "spec-off comparison pass)")
     ap.add_argument("--request-rate", type=float, default=None,
                     help="Poisson arrival rate in req/s (default: offline)")
     args = ap.parse_args()
     if args.request_rate is not None and args.request_rate <= 0:
         ap.error("--request-rate must be > 0")
+    if args.spec_len < 0:
+        ap.error("--spec-len must be >= 0")
+    spec_len = 0 if args.no_spec else args.spec_len
 
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     kw = dict(prefill_chunk=args.prefill_chunk,
@@ -179,19 +227,29 @@ def main():
     if on_tpu:
         config = GPTConfig(vocab_size=50304, hidden_size=2048, num_layers=24,
                            num_heads=16, max_seq_len=2048, dtype=jnp.bfloat16)
-        stats = run_serve_bench(config, num_requests=64, num_slots=32,
-                                page_size=16, max_model_len=1024,
-                                max_new_tokens=64,
-                                request_rate=16.0 if args.request_rate is None
-                                else args.request_rate, **kw)
+        kw.update(config=config, num_requests=64, num_slots=32, page_size=16,
+                  max_model_len=1024, max_new_tokens=64,
+                  request_rate=16.0 if args.request_rate is None
+                  else args.request_rate)
         metric = "serve_decode_tokens_per_sec_per_chip"
     else:  # CI smoke: tiny config, same scheduler/paging code paths
-        stats = run_serve_bench(num_requests=32, num_slots=4, page_size=8,
-                                max_model_len=64, max_new_tokens=6,
-                                request_rate=float("inf") if args.request_rate is None
-                                else args.request_rate,
-                                **kw)
+        kw.update(num_requests=32, num_slots=4, page_size=8, max_model_len=64,
+                  max_new_tokens=6,
+                  request_rate=float("inf") if args.request_rate is None
+                  else args.request_rate)
         metric = "serve_decode_tokens_per_sec (cpu smoke)"
+    stats = run_serve_bench(spec_len=spec_len, **kw)
+    if spec_len:
+        # spec on/off delta on the SAME stream: greedy acceptance is lossless,
+        # so the digests must match and the tokens/s ratio is the honest win
+        base = run_serve_bench(spec_len=0, **kw)
+        stats["no_spec_decode_tokens_per_sec_per_chip"] = \
+            base["decode_tokens_per_sec_per_chip"]
+        stats["spec_speedup"] = round(
+            stats["decode_tokens_per_sec_per_chip"] /
+            max(base["decode_tokens_per_sec_per_chip"], 1e-9), 3)
+        stats["spec_parity"] = \
+            stats["outputs_digest"] == base["outputs_digest"]
     print(json.dumps({"metric": metric,
                       "value": stats["decode_tokens_per_sec_per_chip"],
                       "unit": "tokens/s/chip", **stats}))

@@ -29,6 +29,15 @@ Chunked prefill (Sarathi-Serve, Agrawal et al. OSDI 2024) adds the
 prefix (cached pages or earlier chunks), positions inside the chunk mask
 causally.  The `q_offset` lane rides the scalar prefetch next to the page
 table in the Pallas kernel and is a broadcast add in the XLA oracle.
+
+Speculative decode (Leviathan et al. 2023) verifies `spec_len + 1` candidate
+tokens per slot in one pass.  That IS the q_len > 1 decode case: query t sits
+at position `lengths[b] + t` and attends causally through the page table —
+exactly the prefill pair's contract with `q_offset = lengths` and per-slot
+`valid` counts (`valid = 1` degenerates to vanilla single-token decode, which
+is how undrafted slots ride the same fixed-shape verify executable).
+`paged_verify_attention` is that entry, so the decode-side program budget
+stays at two: `paged_attention_decode` (q_len 1) + the verify lane.
 """
 from __future__ import annotations
 
@@ -338,6 +347,19 @@ def _shapes_ok_for_pallas(q, k_pages):
     hd = q.shape[-1]
     page = k_pages.shape[1]
     return hd in (64, 128, 256) and page % 8 == 0
+
+
+def paged_verify_attention(q, k_pages, v_pages, page_table, lengths, valid,
+                           scale=None):
+    """Entry used by `models.gpt.verify_step_paged`: multi-token (q_len > 1)
+    decode over the paged pool.  q [B, T, H, hd] holds the last emitted token
+    plus up to T-1 drafted tokens per slot; query t sits at absolute position
+    `lengths[b] + t`, and rows t >= valid[b] are padding whose output the
+    scheduler ignores (their KV was routed to the null page).  Same math as
+    the chunked-prefill pair with `q_offset = lengths` — one kernel serves
+    both lanes, keeping the decode-side compiled-program count at two."""
+    return paged_prefill_attention(q, k_pages, v_pages, page_table, lengths,
+                                   valid, scale=scale)
 
 
 def paged_attention_decode(q, k_pages, v_pages, page_table, lengths,

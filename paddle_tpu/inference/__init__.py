@@ -190,7 +190,8 @@ def convert_to_mixed_precision(*a, **k):
 # Serving engine (continuous batching + paged KV cache) — lazy so importing
 # paddle_tpu.inference does not pull the model zoo in.
 _SERVING = {"LLMEngine": "engine", "Request": "engine",
-            "RequestOutput": "engine", "PagedKVCache": "cache"}
+            "RequestOutput": "engine", "PagedKVCache": "cache",
+            "DraftProposer": "spec", "NgramProposer": "spec"}
 
 
 def __getattr__(name):
@@ -203,4 +204,5 @@ def __getattr__(name):
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "PlaceType", "get_version", "convert_to_mixed_precision",
-           "LLMEngine", "Request", "RequestOutput", "PagedKVCache"]
+           "LLMEngine", "Request", "RequestOutput", "PagedKVCache",
+           "DraftProposer", "NgramProposer"]

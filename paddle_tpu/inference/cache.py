@@ -283,6 +283,33 @@ class PagedKVCache:
         do not count — they are reclaimable)."""
         return int((self._ref > 0).sum())
 
+    def check_invariants(self) -> None:
+        """Assert the refcount/free-list/LRU partition is consistent — every
+        real page is exactly one of {free, refcounted-in-use, parked in the
+        evictable LRU}, and refcounts equal the number of slot rows mapping
+        the page.  Tests call this around speculative rollback and abort to
+        prove neither path can leak or double-free a page."""
+        assert (self._ref >= 0).all(), "negative refcount"
+        assert self._ref[NULL_PAGE] == 0, "null page must never be refcounted"
+        counts = np.zeros((self.num_pages,), np.int64)
+        for pages in self._used.values():
+            for p in pages:
+                counts[p] += 1
+        assert (counts == self._ref).all(), \
+            f"refcounts {self._ref.tolist()} != slot usage {counts.tolist()}"
+        free = set(self._free)
+        lru = {n.page for n in self._lru.values()}
+        used = {p for p in range(1, self.num_pages) if self._ref[p] > 0}
+        assert len(free) == len(self._free), "duplicate page on free list"
+        assert not (free & lru) and not (free & used) and not (lru & used), \
+            "page in more than one of free/LRU/in-use"
+        assert free | lru | used == set(range(1, self.num_pages)), \
+            "page leaked out of free/LRU/in-use partition"
+        for node in self._lru.values():
+            assert self._index.get(node.key) is node, "LRU node unregistered"
+        for page, node in self._page_node.items():
+            assert node.page == page
+
     def prefix_stats(self) -> Dict[str, int]:
         return {
             "cached_pages": len(self._index),

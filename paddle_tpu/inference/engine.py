@@ -30,6 +30,23 @@ and Orca's iteration-level scheduling (Yu et al., OSDI 2022), under the same
   program count collapses from #buckets to <= 2.  The legacy bucketed
   one-shot path (`prefill_paged`, power-of-2 buckets) remains the default for
   uncached prompts when `prefill_chunk=None`.
+- **Speculative decoding** (Leviathan et al. 2023; prompt-lookup drafting a la
+  vLLM) — `spec_len=K` breaks the one-token-per-step decode bound: a pluggable
+  `DraftProposer` (default: n-gram self-drafting from the slot's own
+  prompt+generated history, `inference.spec.NgramProposer`) guesses up to K
+  continuation tokens per slot, ONE fixed-shape verify executable
+  (`models.gpt.verify_step_paged`) scores all K+1 positions through the same
+  paged attention, and greedy longest-prefix acceptance emits 1..K+1 tokens
+  with output exactly identical to vanilla decode whenever the verify and
+  decode executables agree at argmax — guaranteed at matching kernel
+  numerics (asserted token-exact on CPU in tests; under TPU bf16 matmuls a
+  near-tie could in principle resolve differently between the two programs,
+  still a valid greedy decode of the model).  Rejected candidates roll
+  back as a per-slot length decrement (their KV is stale garbage inside the
+  slot's own reserved pages, overwritten on reuse); slots with no draft ride
+  the verify program at valid=1 (plain decode), and sampled slots fall back
+  to the vanilla decode program in the same iteration — the decode-side
+  program count stays at two.
 - **Scheduler** — each `step()` admits queued requests into free slots
   (reservation-based page admission with prefix matching), advances at most
   one prefill chunk, runs one decode iteration over all fully-prefilled
@@ -37,8 +54,8 @@ and Orca's iteration-level scheduling (Yu et al., OSDI 2022), under the same
   their pages to the refcounted pool.
 
 `bench_serve.py` replays a Poisson request stream through this engine and
-reports decode tokens/s/chip, TTFT percentiles, prefix-cache hit rate and
-compiled-program counts.
+reports decode tokens/s/chip, TTFT percentiles, prefix-cache hit rate,
+accepted tokens per verify step and compiled-program counts.
 """
 from __future__ import annotations
 
@@ -54,15 +71,22 @@ import numpy as np
 
 from ..models import gpt as gpt_mod
 from .cache import PagedKVCache
+from .spec import DraftProposer, NgramProposer
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(eq=False)
 class Request:
-    """One generation request: prompt token ids + a decode budget."""
+    """One generation request: prompt token ids + a decode budget.
+
+    temperature=None inherits the engine's sampling mode; 0.0 forces the
+    greedy fast path for this request (argmax, PRNG-key independent) even on
+    a sampling engine.  eq=False: identity comparison only — the generated
+    __eq__ would compare numpy prompts, whose truth value is ambiguous."""
     prompt: np.ndarray
     max_new_tokens: int = 16
     request_id: int = -1
     t_enqueue: float = 0.0
+    temperature: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -89,6 +113,7 @@ class _Running:
     generated: List[int]
     cached_tokens: int = 0
     ttft_s: Optional[float] = None
+    greedy: bool = True             # resolved request temperature == 0.0
 
 
 @dataclasses.dataclass
@@ -126,6 +151,14 @@ class LLMEngine:
     ladder to N-token chunks interleaved one-per-step with decode.  Both are
     scheduler-level: the decode executable, page pool and table shapes are
     identical in every mode.
+
+    `spec_len=K` (> 0) enables speculative decoding: `draft_proposer`
+    (default `NgramProposer`) guesses up to K continuation tokens per greedy
+    slot each iteration, one fixed-shape verify executable scores K+1
+    positions, and greedy longest-prefix acceptance emits 1..K+1 tokens per
+    step with exact vanilla-decode token parity.  Drafting applies only to
+    greedy slots — acceptance needs a deterministic pick — so sampled slots
+    keep the vanilla decode program.
     """
 
     def __init__(self, params, config: gpt_mod.GPTConfig, *,
@@ -137,6 +170,8 @@ class LLMEngine:
                  prefix_cache: bool = True,
                  eos_token_id: Optional[int] = None,
                  temperature: float = 0.0, top_k: Optional[int] = None,
+                 spec_len: int = 0,
+                 draft_proposer: Optional[DraftProposer] = None,
                  seed: int = 0):
         self.params = params
         self.config = config
@@ -174,6 +209,13 @@ class LLMEngine:
         # largest bucket bounds any tail in one call
         self._chunk = prefill_chunk if self.chunked else self.buckets[-1]
         self.prefix_cache = prefix_cache
+        if spec_len < 0:
+            raise ValueError(f"spec_len must be >= 0, got {spec_len}")
+        if spec_len and spec_len + 1 > max_model_len:
+            raise ValueError(f"spec_len {spec_len} + 1 exceeds max_model_len")
+        self.spec_len = spec_len
+        self.proposer = (draft_proposer or NgramProposer()) if spec_len \
+            else draft_proposer
         self.cache = PagedKVCache(num_pages, page_size, num_slots,
                                   max_pages_per_slot)
         self._pool = gpt_mod.init_paged_cache(config, num_pages, page_size)
@@ -186,31 +228,54 @@ class LLMEngine:
         self._outputs: Dict[int, RequestOutput] = {}
 
         sample = bool(temperature and temperature > 0.0)
+        self._sample = sample
+        self._temperature = temperature
 
-        def pick(logits, key):
-            # gpt.sample_token is shared with generate() — parity by construction
-            return gpt_mod.sample_token(logits, key, sample=sample,
-                                        temperature=temperature, top_k=top_k)
+        if sample:
+            def pick(logits, key, greedy):
+                # gpt.sample_token is shared with generate() — parity by
+                # construction; the greedy mask routes per-request
+                # temperature=0.0 slots through argmax (their output is
+                # PRNG-independent; the batch-wide split still advances)
+                ids, key = gpt_mod.sample_token(logits, key, sample=True,
+                                                temperature=temperature,
+                                                top_k=top_k)
+                greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return jnp.where(greedy, greedy_ids, ids), key
+        else:
+            def pick(logits, key, greedy):
+                # fully greedy engine: argmax, the PRNG key is never consumed
+                return gpt_mod.sample_token(logits, key, sample=False,
+                                            temperature=temperature,
+                                            top_k=top_k)
 
         cfg = config
 
-        def decode_impl(params, tokens, pool, table, lengths, key):
+        def decode_impl(params, tokens, pool, table, lengths, key, greedy):
             logits, pool = gpt_mod.decode_step_paged(params, tokens, pool,
                                                      table, lengths, cfg)
-            nxt, key = pick(logits, key)
+            nxt, key = pick(logits, key, greedy)
             return nxt, pool, key
 
-        def prefill_impl(params, ids, pool, pages, length, key):
+        def prefill_impl(params, ids, pool, pages, length, key, greedy):
             logits, pool = gpt_mod.prefill_paged(params, ids, cfg, pool,
                                                  pages, length)
-            first, key = pick(logits, key)
+            first, key = pick(logits, key, greedy)
             return first, pool, key
 
-        def chunk_impl(params, ids, pool, table, q_offset, valid, key):
+        def chunk_impl(params, ids, pool, table, q_offset, valid, key, greedy):
             logits, pool = gpt_mod.prefill_chunk_paged(params, ids, cfg, pool,
                                                        table, q_offset, valid)
-            tok, key = pick(logits, key)
+            tok, key = pick(logits, key, greedy)
             return tok, pool, key
+
+        def verify_impl(params, tokens, pool, table, lengths, valid):
+            # greedy-only lane: acceptance compares argmax at every position,
+            # no key threads through (spec parity requires determinism)
+            logits, pool = gpt_mod.verify_step_paged(params, tokens, pool,
+                                                     table, lengths, valid,
+                                                     cfg)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), pool
 
         def copy_impl(pool, src, dst):
             # COW page copy: one [page, KVH, hd] slab per layer, src -> dst
@@ -221,6 +286,7 @@ class LLMEngine:
         self._decode_fn = jax.jit(decode_impl, donate_argnums=(2,))
         self._prefill_fn = jax.jit(prefill_impl, donate_argnums=(2,))
         self._chunk_fn = jax.jit(chunk_impl, donate_argnums=(2,))
+        self._verify_fn = jax.jit(verify_impl, donate_argnums=(2,))
         self._copy_fn = jax.jit(copy_impl, donate_argnums=(0,))
         self._seen_buckets = set()
         self._chunk_used = False
@@ -231,22 +297,45 @@ class LLMEngine:
         """Zero the throughput/prefix counters (stats(), not executables) —
         benches call this after warmup so compile-time traffic is excluded."""
         self._decode_iters = 0
-        self._decode_tokens = 0         # per-iteration ACTIVE slots summed
+        self._decode_tokens = 0         # tokens EMITTED by decode/verify steps
         self._prefill_chunks = 0
         self._prefilled_tokens = 0      # prompt tokens actually computed
         self._prefix_cached_tokens = 0  # prompt tokens served from the cache
         self._prefix_hit_requests = 0
         self._cow_copies = 0
+        self._verify_steps = 0          # verify-program dispatches
+        self._spec_events = 0           # per-slot verify events WITH a draft
+        self._spec_drafted = 0          # drafted tokens offered to verify
+        self._spec_accepted = 0         # drafted tokens accepted
+        self._spec_emitted = 0          # accepted + bonus tokens emitted
         self.cache.prefix_evictions = 0
 
     # ---- request intake ---------------------------------------------------
-    def add_request(self, prompt, max_new_tokens: int = 16) -> int:
+    def add_request(self, prompt, max_new_tokens: int = 16,
+                    temperature: Optional[float] = None) -> int:
+        """Enqueue one request.  temperature=None inherits the engine's
+        sampling mode; 0.0 is the per-request greedy fast path (argmax pick,
+        output independent of the PRNG stream — what speculative decoding
+        verifies against).  A positive value must equal the engine's compiled
+        temperature: the sampling variant is baked into the executables."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, "
                              f"got {max_new_tokens}")
+        if temperature is not None and temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0.0, got {temperature}")
+        if temperature is not None and temperature > 0.0:
+            if not self._sample:
+                raise ValueError(
+                    "engine compiled greedy (temperature=0.0) cannot serve "
+                    "sampled requests; construct it with temperature > 0")
+            if temperature != self._temperature:
+                raise ValueError(
+                    f"per-request temperature {temperature} != engine "
+                    f"temperature {self._temperature}; only the greedy fast "
+                    f"path (temperature=0.0) overrides per request")
         if not self.chunked and prompt.size > self.buckets[-1]:
             raise ValueError(f"prompt length {prompt.size} exceeds largest "
                              f"prefill bucket {self.buckets[-1]}")
@@ -256,8 +345,12 @@ class LLMEngine:
                              f"max_model_len {self.max_model_len}")
         rid = next(self._ids)
         self._queue.append(Request(prompt, max_new_tokens, rid,
-                                   time.perf_counter()))
+                                   time.perf_counter(), temperature))
         return rid
+
+    def _req_greedy(self, req: Request) -> bool:
+        t = req.temperature
+        return (not self._sample) if t is None else t <= 0.0
 
     def abort(self, request_id: int) -> bool:
         """Cancel a queued or in-flight request and free/deref its pages
@@ -266,9 +359,13 @@ class LLMEngine:
         deref-counted; the request lands in the outputs map with
         finish_reason="abort" and whatever tokens it had produced.  Returns
         False when the id is unknown or already finished."""
-        for req in self._queue:
+        for i, req in enumerate(self._queue):
             if req.request_id == request_id:
-                self._queue.remove(req)
+                # del by index, NOT deque.remove: remove's equality scan would
+                # run Request.__eq__ against every earlier entry, and numpy
+                # prompt comparison has no scalar truth value (it raised for
+                # any aborted request not at the head of the queue)
+                del self._queue[i]
                 self._finish_output(req, [], "abort", 0, None)
                 return True
         for slot, st in list(self._prefilling.items()):
@@ -361,7 +458,7 @@ class LLMEngine:
                 first, self._pool, self._key = self._prefill_fn(
                     self.params, jnp.asarray(ids), self._pool,
                     jnp.asarray(pages), jnp.asarray([lp], jnp.int32),
-                    self._key)
+                    self._key, jnp.asarray([self._req_greedy(req)]))
                 self._seen_buckets.add(bucket)
                 self._prefilled_tokens += lp
                 if self.prefix_cache:
@@ -389,7 +486,7 @@ class LLMEngine:
             self.params, jnp.asarray(ids), self._pool,
             jnp.asarray(mgr.page_table[slot][None, :]),
             jnp.asarray([st.filled], jnp.int32), jnp.asarray([n], jnp.int32),
-            self._key)
+            self._key, jnp.asarray([self._req_greedy(st.request)]))
         self._chunk_used = True
         self._prefill_chunks += 1
         self._prefilled_tokens += n
@@ -406,34 +503,173 @@ class LLMEngine:
         """Prompt fully in pages + first token picked: join the decode set."""
         self.cache.lengths[slot] = req.prompt.size
         ttft = time.perf_counter() - req.t_enqueue
-        seq = _Running(req, slot, [first], cached, ttft)
+        seq = _Running(req, slot, [first], cached, ttft,
+                       self._req_greedy(req))
         if not self._maybe_finish(seq, finished):
             self._running[slot] = seq
 
     def _decode_iter(self, finished: List[RequestOutput]) -> None:
-        mgr = self.cache
-        tokens = np.zeros((mgr.num_slots,), np.int32)
+        """One decode iteration over every fully-prefilled slot: when any
+        greedy slot has a draft, greedy slots ride the verify executable
+        (undrafted ones at valid=1 — plain decode through the same program)
+        and sampled slots fall back to the vanilla decode executable in the
+        same iteration; otherwise everything takes the vanilla path."""
+        self._decode_iters += 1
+        drafts = self._propose_drafts() if self.spec_len else {}
+        if drafts:
+            self._verify_iter(drafts, finished)
+            rest = [s for s, seq in self._running.items() if not seq.greedy]
+        else:
+            rest = list(self._running)
+        if rest:
+            self._vanilla_decode_iter(rest, finished)
+
+    def _propose_drafts(self) -> Dict[int, np.ndarray]:
+        """Ask the proposer for up to spec_len continuation tokens per greedy
+        slot, capped at the slot's remaining decode budget so speculative KV
+        writes stay inside the reservation (prompt + max_new_tokens).  When
+        the proposer declares a bounded lookback, only that history tail is
+        materialized — this runs on the host every decode iteration, so the
+        work per slot must not grow with context length."""
+        drafts: Dict[int, np.ndarray] = {}
+        win = getattr(self.proposer, "max_lookback", 0)
         for slot, seq in self._running.items():
+            if not seq.greedy:
+                continue            # acceptance needs a deterministic pick
+            cap = min(self.spec_len,
+                      seq.request.max_new_tokens - len(seq.generated))
+            if cap < 1:
+                continue
+            if win:
+                gen = np.asarray(seq.generated[-win:], np.int32)
+                head = seq.request.prompt[-(win - gen.size):] \
+                    if gen.size < win else seq.request.prompt[:0]
+                ctx = np.concatenate([head, gen])
+            else:
+                ctx = np.concatenate([seq.request.prompt,
+                                      np.asarray(seq.generated, np.int32)])
+            d = self.proposer.propose(ctx, cap)
+            if d is not None and len(d):
+                drafts[slot] = np.asarray(d, np.int32).reshape(-1)[:cap]
+        return drafts
+
+    def _verify_iter(self, drafts: Dict[int, np.ndarray],
+                     finished: List[RequestOutput]) -> None:
+        """Score spec_len + 1 positions for every greedy slot in ONE verify
+        dispatch, then accept the longest drafted prefix the model agrees
+        with plus the bonus token from the first disagreeing position.
+        Rollback of rejected candidates is a length decrement: lengths only
+        ever advances past KV that is certainly correct, and the stale
+        candidate KV above it sits in the slot's own reserved pages where the
+        next decode/verify write overwrites it before it can be attended."""
+        mgr = self.cache
+        B, T = mgr.num_slots, self.spec_len + 1
+        tokens = np.zeros((B, T), np.int32)
+        valid = np.ones((B,), np.int32)
+        qoff = np.zeros((B,), np.int32)
+        # free/prefilling/sampled slots must look inactive: null table rows
+        # route their (garbage) KV writes to the null page
+        table = mgr.page_table.copy()
+        active = []
+        for slot in range(B):
+            seq = self._running.get(slot)
+            if seq is None or not seq.greedy:
+                table[slot, :] = 0
+                continue
+            active.append(slot)
+            tokens[slot, 0] = seq.generated[-1]
+            d = drafts.get(slot)
+            if d is not None:
+                tokens[slot, 1:1 + d.size] = d
+                valid[slot] = 1 + d.size
+            qoff[slot] = mgr.lengths[slot]
+        preds, self._pool = self._verify_fn(
+            self.params, jnp.asarray(tokens), self._pool, jnp.asarray(table),
+            jnp.asarray(qoff), jnp.asarray(valid))
+        preds = np.asarray(preds)
+        self._verify_steps += 1
+        for slot in active:
+            seq = self._running[slot]
+            d = drafts.get(slot)
+            nd = 0 if d is None else d.size
+            a = 0
+            while a < nd and int(d[a]) == int(preds[slot, a]):
+                a += 1          # greedy longest-prefix acceptance
+            emitted = [int(x) for x in d[:a]] if nd else []
+            emitted.append(int(preds[slot, a]))        # bonus token
+            room = seq.request.max_new_tokens - len(seq.generated)
+            emitted = emitted[:room]
+            if self.eos_token_id is not None and self.eos_token_id in emitted:
+                emitted = emitted[:emitted.index(self.eos_token_id) + 1]
+            mgr.lengths[slot] += len(emitted)          # rejected KV: stale
+            seq.generated.extend(emitted)
+            self._decode_tokens += len(emitted)
+            if nd:
+                self._spec_events += 1
+                self._spec_drafted += nd
+                self._spec_accepted += a
+                self._spec_emitted += len(emitted)
+            if self._maybe_finish(seq, finished):
+                del self._running[slot]
+
+    def _vanilla_decode_iter(self, slots: List[int],
+                             finished: List[RequestOutput]) -> None:
+        mgr = self.cache
+        active = set(slots)
+        tokens = np.zeros((mgr.num_slots,), np.int32)
+        greedy = np.zeros((mgr.num_slots,), bool)
+        for slot in active:
+            seq = self._running[slot]
             tokens[slot] = seq.generated[-1]
+            greedy[slot] = seq.greedy
         table = mgr.page_table
-        if self._prefilling:
-            # mid-prefill slots must look inactive to the decode executable:
-            # a null table row routes its (garbage) KV write to the null page
-            # instead of position lengths[slot]=0 of the slot's REAL first page
+        # mid-prefill slots and running slots already served by this
+        # iteration's verify dispatch must look inactive to the decode
+        # executable: a null table row routes its (garbage) KV write to the
+        # null page instead of a position inside the slot's REAL pages
+        masked = [s for s in range(mgr.num_slots)
+                  if s in self._prefilling or
+                  (s in self._running and s not in active)]
+        if masked:
             table = table.copy()
-            for slot in self._prefilling:
+            for slot in masked:
                 table[slot, :] = 0
         nxt, self._pool, self._key = self._decode_fn(
             self.params, jnp.asarray(tokens), self._pool,
-            jnp.asarray(table), jnp.asarray(mgr.lengths), self._key)
-        self._decode_iters += 1
-        self._decode_tokens += len(self._running)
+            jnp.asarray(table), jnp.asarray(mgr.lengths), self._key,
+            jnp.asarray(greedy))
+        self._decode_tokens += len(active)
         nxt = np.asarray(nxt)
-        for slot, seq in list(self._running.items()):
+        for slot in slots:
+            seq = self._running[slot]
             mgr.lengths[slot] += 1          # the token we just fed is cached
             seq.generated.append(int(nxt[slot]))
             if self._maybe_finish(seq, finished):
                 del self._running[slot]
+
+    def warm_spec(self) -> None:
+        """Compile the verify executable against inert inputs (all slots
+        masked to the null page) — benches call this during warmup so the
+        one-off compile stays out of timed counters."""
+        if not self.spec_len:
+            return
+        B, T = self.cache.num_slots, self.spec_len + 1
+        _, self._pool = self._verify_fn(
+            self.params, jnp.zeros((B, T), jnp.int32), self._pool,
+            jnp.zeros((B, self.cache.max_pages_per_slot), jnp.int32),
+            jnp.zeros((B,), jnp.int32), jnp.ones((B,), jnp.int32))
+
+    def warm_decode(self) -> None:
+        """Compile the vanilla decode executable against inert inputs — a
+        1-token warmup request picks its only token at prefill and retires
+        without ever decoding, so benches warm the decode program explicitly.
+        On a sampling engine this advances the PRNG stream by one split, like
+        any real decode dispatch would."""
+        B = self.cache.num_slots
+        _, self._pool, self._key = self._decode_fn(
+            self.params, jnp.zeros((B,), jnp.int32), self._pool,
+            jnp.zeros((B, self.cache.max_pages_per_slot), jnp.int32),
+            jnp.zeros((B,), jnp.int32), self._key, jnp.zeros((B,), bool))
 
     def _maybe_finish(self, seq: _Running,
                       finished: List[RequestOutput]) -> bool:
@@ -474,6 +710,8 @@ class LLMEngine:
         return {
             "decode_executables": execs(self._decode_fn,
                                         1 if self._decode_iters else 0),
+            "verify_executables": execs(self._verify_fn,
+                                        1 if self._verify_steps else 0),
             "prefill_executables": execs(self._prefill_fn,
                                          len(self._seen_buckets)) +
                                    execs(self._chunk_fn,
@@ -482,8 +720,17 @@ class LLMEngine:
                                       1 if self._copy_used else 0),
             "buckets": list(self.buckets),
             "prefill_chunk": self.prefill_chunk,
+            "spec_len": self.spec_len,
             "decode_iterations": self._decode_iters,
             "decode_tokens": self._decode_tokens,
+            "verify_steps": self._verify_steps,
+            "spec_drafted_tokens": self._spec_drafted,
+            "spec_accepted_tokens": self._spec_accepted,
+            "spec_emitted_tokens": self._spec_emitted,
+            # mean tokens emitted per drafted verify event (>= 1.0; 1.0 means
+            # drafts never helped, spec_len+1 means every draft fully accepted)
+            "accepted_per_step": self._spec_emitted / self._spec_events
+                                 if self._spec_events else 0.0,
             "prefill_chunks": self._prefill_chunks,
             "prefilled_tokens": computed,
             "prefix_cached_tokens": cached,

@@ -826,6 +826,51 @@ def prefill_paged(params, input_ids, config: GPTConfig, cache, pages, length):
     return jnp.matmul(x, head_matrix(params, c)), {"k": new_k, "v": new_v}
 
 
+def _paged_chunk_hidden(params, input_ids, config: GPTConfig, cache,
+                        page_table, q_offset, valid, attn_entry=None):
+    """Shared trunk of the q_offset-masked paged passes (`prefill_chunk_paged`
+    and `verify_step_paged`): embed a [B, C] token chunk starting at per-slot
+    absolute position q_offset, write its KV token-granularly at
+    page_table[(q_offset+t) // page][(q_offset+t) % page] (padded tail rows
+    t >= valid route to the reserved null page 0), and attend through the page
+    table to everything already written below it.  attn_entry overrides the
+    attention routing (the verify lane passes its own entry so lane-specific
+    kernel behavior lands there, not here).  Returns (hidden states [B, C, D]
+    BEFORE the final norm/head — callers pick their positions — and the
+    updated cache)."""
+    from ..incubate.kernels.paged_attention import paged_prefill_attention
+    attn_fn = attn_entry or paged_prefill_attention
+    c = config
+    assert c.causal, "KV-cache decoding requires a causal model"
+    B, C = input_ids.shape
+    D = c.hidden_size
+    page = cache["k"].shape[2]
+    pos = q_offset[:, None] + jnp.arange(C)                  # [B, C]
+    real = jnp.arange(C)[None, :] < valid[:, None]           # [B, C]
+    x = jnp.take(params["wte"], input_ids, axis=0)
+    if not c.use_rope:
+        # jnp.take clips padded-tail positions past wpe; their rows are junk
+        # the scheduler never reads (rows >= valid are never consumed)
+        x = x + jnp.take(params["wpe"], pos, axis=0)
+    pidx = jnp.take_along_axis(page_table, pos // page, axis=1)
+    pidx = jnp.where(real, pidx, 0)                          # pad -> null page
+    off = pos % page
+
+    def layer(x, layer_in):
+        bp, kc, vc = layer_in
+        q, k, v = _prefill_qkv(bp, x, c, pos=pos)
+        kc = kc.at[pidx, off].set(k)          # token-granular page scatter
+        vc = vc.at[pidx, off].set(v)
+        attn = attn_fn(q, kc, vc, page_table, q_offset, valid)
+        x = _layer_tail(bp, x, attn.reshape(B, C, D), c)
+        return x, (kc, vc)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        lambda carry, inp: layer(carry, inp),
+        x, (params["blocks"], cache["k"], cache["v"]))
+    return x, {"k": new_k, "v": new_v}
+
+
 def prefill_chunk_paged(params, input_ids, config: GPTConfig, cache,
                         page_table, q_offset, valid):
     """Chunked paged prefill (Sarathi-style, Agrawal et al. OSDI 2024): one
@@ -845,38 +890,43 @@ def prefill_chunk_paged(params, input_ids, config: GPTConfig, cache,
     (logits [B, V] at chunk index valid-1 — the caller uses them only for the
     final chunk — and the updated cache).
     """
-    from ..incubate.kernels.paged_attention import paged_prefill_attention
-    c = config
-    assert c.causal, "KV-cache decoding requires a causal model"
-    B, C = input_ids.shape
-    D, H, KVH, hd = c.hidden_size, c.num_heads, c.kv_heads, c.head_dim
-    page = cache["k"].shape[2]
-    pos = q_offset[:, None] + jnp.arange(C)                  # [B, C]
-    real = jnp.arange(C)[None, :] < valid[:, None]           # [B, C]
-    x = jnp.take(params["wte"], input_ids, axis=0)
-    if not c.use_rope:
-        # jnp.take clips padded-tail positions past wpe; their rows are junk
-        # the scheduler never reads (valid-1 is always a real position)
-        x = x + jnp.take(params["wpe"], pos, axis=0)
-    pidx = jnp.take_along_axis(page_table, pos // page, axis=1)
-    pidx = jnp.where(real, pidx, 0)                          # pad -> null page
-    off = pos % page
-
-    def layer(x, layer_in):
-        bp, kc, vc = layer_in
-        q, k, v = _prefill_qkv(bp, x, c, pos=pos)
-        kc = kc.at[pidx, off].set(k)          # token-granular page scatter
-        vc = vc.at[pidx, off].set(v)
-        attn = paged_prefill_attention(q, kc, vc, page_table, q_offset, valid)
-        x = _layer_tail(bp, x, attn.reshape(B, C, D), c)
-        return x, (kc, vc)
-
-    x, (new_k, new_v) = jax.lax.scan(
-        lambda carry, inp: layer(carry, inp),
-        x, (params["blocks"], cache["k"], cache["v"]))
+    B = input_ids.shape[0]
+    x, cache = _paged_chunk_hidden(params, input_ids, config, cache,
+                                   page_table, q_offset, valid)
     x = x[jnp.arange(B), valid - 1]                  # last real chunk position
-    x = epilogue(params, x, c)
-    return jnp.matmul(x, head_matrix(params, c)), {"k": new_k, "v": new_v}
+    x = epilogue(params, x, config)
+    return jnp.matmul(x, head_matrix(params, config)), cache
+
+
+def verify_step_paged(params, tokens, cache, page_table, lengths, valid,
+                      config: GPTConfig):
+    """Speculative-decode verify (Leviathan et al. 2023): score spec_len + 1
+    positions per slot in ONE fixed-shape executable — the multi-token sibling
+    of `decode_step_paged`, riding the same q_offset-masked paged attention as
+    `prefill_chunk_paged`.
+
+    tokens [B, T] int32 (T = spec_len + 1): tokens[:, 0] is the slot's last
+    emitted token (exactly what vanilla decode would be fed), tokens[:, 1:]
+    the drafted continuation; token t sits at absolute position lengths[b] + t.
+    lengths [B] int32 — tokens already cached per slot (the verify analogue of
+    decode's per-slot position); valid [B] int32 in [1, T] — real tokens per
+    slot (1 = no draft, plain decode through the verify program).  Candidate
+    KV is written token-granularly into the slot's reserved pages (rows
+    t >= valid route to the null page); the caller rolls rejected positions
+    back by NOT advancing lengths past the accepted prefix — the stale KV is
+    overwritten when decode reaches those positions again.
+
+    Returns (logits [B, T, V] at EVERY position — logits[b, t] predicts the
+    token after tokens[b, t], so greedy acceptance compares argmax(logits[:, t])
+    against tokens[:, t+1] and argmax(logits[:, a]) is the bonus token — and
+    the updated cache).
+    """
+    from ..incubate.kernels.paged_attention import paged_verify_attention
+    x, cache = _paged_chunk_hidden(params, tokens, config, cache,
+                                   page_table, lengths, valid,
+                                   attn_entry=paged_verify_attention)
+    x = epilogue(params, x, config)
+    return jnp.matmul(x, head_matrix(params, config)), cache
 
 
 # LRU-bounded executable cache for `generate` (unbounded it leaks one compiled

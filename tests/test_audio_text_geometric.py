@@ -103,6 +103,7 @@ def test_geometric_sampling_and_reindex():
     np.testing.assert_array_equal(d.numpy(), [0, 0, 1])
 
 
+@pytest.mark.slow      # builds + forwards 13 model families (~80 s compile)
 def test_vision_families_complete():
     from paddle_tpu.vision import models as M
     fams = ["ResNet", "VGG", "LeNet", "AlexNet", "MobileNetV1", "MobileNetV2",

@@ -94,6 +94,7 @@ def test_auto_tuner_candidates_pruned():
             assert c.micro_batches % c.pp == 0
 
 
+@pytest.mark.slow      # timed trials compile one program per candidate (~84 s)
 def test_auto_tuner_finds_working_config():
     import jax
     from paddle_tpu.distributed.auto_tuner import tune

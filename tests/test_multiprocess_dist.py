@@ -16,6 +16,14 @@ import sys
 import numpy as np
 import pytest
 
+# slow tier: each test spawns a real multi-process cluster (launch CLI +
+# jax.distributed bring-up, 10-30 s apiece, ~75 s for the module) — and on
+# CPU-only jaxlib, which ships no cross-process collectives, they can only
+# fail (as at seed; see CHANGES PR 1).  The tier-1 budget (ROADMAP, 870 s)
+# is for the fast gate; run these via `-m slow` on a backend with real
+# cross-process collectives.
+pytestmark = pytest.mark.slow
+
 SCRIPTS = os.path.join(os.path.dirname(__file__), "dist_scripts")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

@@ -145,7 +145,8 @@ def test_flash_attention_segment_ids_api():
     np.testing.assert_allclose(out.numpy(), np.asarray(ref), atol=1e-5)
 
 
-def test_cp_composes_with_pipeline():
+@pytest.mark.slow      # deepest cp x pp combo (~38 s compile), like the PR-1
+def test_cp_composes_with_pipeline():   # deep-combo parity moves to slow tier
     """cp folded into the pp manual region: ring attention inside pipeline
     ticks, per-shard RoPE offsets, CE folds cp into its manual seq axes."""
     from paddle_tpu.models.gpt import GPTConfig

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""CI guard: the serving engine's compiled-program budget.
+
+Continuous batching is only viable on TPU because the engine runs a FIXED set
+of executables regardless of traffic shape (README "Serving" section).  The
+documented budget, which this script re-measures on every run so a future PR
+cannot silently reintroduce per-shape recompiles:
+
+- decode-side: <= 2 programs (vanilla `decode_step_paged` + the spec-decode
+  `verify_step_paged`) — one token or spec_len+1 tokens per slot per step,
+  nothing else;
+- prefill-side (chunked mode): <= 2 programs (the q_offset chunk executable;
+  the bucketed ladder is off);
+- copy: <= 1 program (the COW page copy);
+- total: <= 5.
+
+Runs the bench_serve CPU smoke (chunked prefill + prefix cache + speculative
+decoding — every lane the scheduler can dispatch) and exits non-zero with a
+diff against the budget on violation.
+
+Usage: JAX_PLATFORMS=cpu python tools/check_program_count.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BUDGET = {
+    "decode_side_executables": 2,   # decode + verify
+    "prefill_executables": 2,
+    "copy_executables": 1,
+    "total_executables": 5,
+}
+
+
+def measure():
+    from bench_serve import run_serve_bench
+    stats = run_serve_bench(num_requests=12, num_slots=2, page_size=8,
+                            max_model_len=64, max_new_tokens=6,
+                            prefill_chunk=16, prefix_cache=True,
+                            shared_prefix_frac=0.5, spec_len=4, seed=11)
+    got = {
+        "decode_side_executables": stats["decode_executables"] +
+                                   stats["verify_executables"],
+        "prefill_executables": stats["prefill_executables"],
+        "copy_executables": stats["copy_executables"],
+    }
+    got["total_executables"] = (got["decode_side_executables"] +
+                                got["prefill_executables"] +
+                                got["copy_executables"])
+    return got, stats
+
+
+def main() -> int:
+    got, stats = measure()
+    over = {k: (got[k], BUDGET[k]) for k in BUDGET if got[k] > BUDGET[k]}
+    print(json.dumps({"metric": "serve_compiled_program_count",
+                      "budget": BUDGET, "measured": got,
+                      "accepted_per_step": stats["accepted_per_step"],
+                      "ok": not over}))
+    if over:
+        for k, (g, b) in over.items():
+            print(f"FAIL: {k} = {g} exceeds documented budget {b} — a code "
+                  f"path is recompiling per shape; see README 'Serving'",
+                  file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
