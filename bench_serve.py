@@ -29,6 +29,14 @@ matching kernel numerics; a TPU bf16 near-tie can in principle diverge).
 The decode and verify executables are compiled during warmup
 (`LLMEngine.warm_decode`/`warm_spec`) so the timed section measures
 steady-state serving.
+
+`--mp N` serves tensor-parallel over N chips: Megatron-sharded serving params
+(qkv/fc1 column-, proj/fc2 row-split), page pool head-sharded, paged
+attention per-chip on the local head slice.  Greedy outputs are
+token-identical to single-chip, and `decode_tokens_per_sec_per_chip` divides
+by N.  On CPU, simulate the chips:
+`XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \\
+    python bench_serve.py --mp 2` (set automatically when absent).
 """
 from __future__ import annotations
 
@@ -42,7 +50,7 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
                     page_size=8, max_model_len=None, max_new_tokens=8,
                     request_rate=float("inf"), seed=0, params=None,
                     prefill_chunk=None, prefix_cache=True,
-                    shared_prefix_frac=0.0, spec_len=0):
+                    shared_prefix_frac=0.0, spec_len=0, mp=1):
     """Replay a Poisson request stream through LLMEngine; returns the metrics
     dict (also the CI smoke entrypoint — tests assert on the executable
     counts, the prefix-cache hit rate and the speculative acceptance rate).
@@ -53,7 +61,10 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
     too).  spec_len > 0 enables n-gram speculative decoding; the returned
     `outputs_digest` hashes every request's generated tokens in request-id
     order, so spec-on and spec-off passes over the same stream can assert
-    exact greedy parity."""
+    exact greedy parity.  mp > 1 serves tensor-parallel over the first mp
+    devices (head-sharded paged attention + Megatron serving params);
+    tokens/s-per-chip then divides by the mesh size — the honest multi-chip
+    number."""
     import hashlib
 
     import jax
@@ -69,7 +80,8 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
 
     eng = LLMEngine(params, config, num_slots=num_slots, page_size=page_size,
                     max_model_len=max_model_len, prefill_chunk=prefill_chunk,
-                    prefix_cache=prefix_cache, spec_len=spec_len)
+                    prefix_cache=prefix_cache, spec_len=spec_len,
+                    mp=mp if mp and mp > 1 else None)
     rng = np.random.RandomState(seed)
     max_prompt = max_model_len - max_new_tokens
     shared = None
@@ -156,8 +168,11 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
         digest.update(np.asarray([o.request_id, len(o.token_ids)],
                                  np.int64).tobytes())
         digest.update(np.asarray(o.token_ids, np.int64).tobytes())
-    n_chips = max(1, len(jax.devices()))
+    # an mp mesh uses exactly mp chips; single-chip serving uses one program
+    # on however many devices the host exposes (forced-CPU CI counts them all)
+    n_chips = eng.mp if eng.mp > 1 else max(1, len(jax.devices()))
     return {
+        "mp": eng.mp,
         "decode_tokens_per_sec_per_chip": round(decode_tokens / dt / n_chips, 1),
         "generated_tokens_per_sec": round(num_requests * max_new_tokens / dt, 1),
         "requests": num_requests,
@@ -191,13 +206,14 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
 
 def main():
     import argparse
-
-    import jax
-    import jax.numpy as jnp
-
-    from paddle_tpu.models.gpt import GPTConfig
+    import os
 
     ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--mp", type=int, default=1,
+                    help="tensor-parallel degree: shard the serving model "
+                         "over the first N chips (heads + FFN Megatron-style;"
+                         " on CPU, simulate chips with XLA_FLAGS="
+                         "--xla_force_host_platform_device_count=8)")
     ap.add_argument("--shared-prefix-frac", type=float, default=0.0,
                     help="fraction of requests sharing a common prompt prefix")
     ap.add_argument("--prefill-chunk", type=int, default=None,
@@ -218,12 +234,28 @@ def main():
         ap.error("--request-rate must be > 0")
     if args.spec_len < 0:
         ap.error("--spec-len must be >= 0")
+    if args.mp < 1:
+        ap.error("--mp must be >= 1")
     spec_len = 0 if args.no_spec else args.spec_len
+    if args.mp > 1:
+        # make the CPU host expose enough virtual chips BEFORE jax initializes
+        # (same trick as the multichip training dryrun); harmless on TPU
+        flag = f"--xla_force_host_platform_device_count={max(args.mp, 8)}"
+        if "--xla_force_host_platform_device_count" not in \
+                os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = \
+                (os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.gpt import GPTConfig
 
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     kw = dict(prefill_chunk=args.prefill_chunk,
               prefix_cache=not args.no_prefix_cache,
-              shared_prefix_frac=args.shared_prefix_frac)
+              shared_prefix_frac=args.shared_prefix_frac,
+              mp=args.mp)
     if on_tpu:
         config = GPTConfig(vocab_size=50304, hidden_size=2048, num_layers=24,
                            num_heads=16, max_seq_len=2048, dtype=jnp.bfloat16)

@@ -38,6 +38,12 @@ exactly the prefill pair's contract with `q_offset = lengths` and per-slot
 is how undrafted slots ride the same fixed-shape verify executable).
 `paged_verify_attention` is that entry, so the decode-side program budget
 stays at two: `paged_attention_decode` (q_len 1) + the verify lane.
+
+Multi-chip serving (PR 4) makes every entry mesh-aware: pass `mesh=` with an
+'mp' axis and the attention runs head-sharded tensor-parallel — the
+`paged_*_mp` wrappers shard q on its head axis and the pool on KVH, running
+the unmodified Pallas kernel per-shard (shard_map) or the XLA oracle under
+sharding constraints.  See the block comment above `_POOL_SPEC`.
 """
 from __future__ import annotations
 
@@ -46,8 +52,105 @@ import math
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .flash_attention import NEG_INF, _on_tpu
+
+# Tensor-parallel serving (multi-chip): attention is embarrassingly parallel
+# over heads — no cross-head reduction anywhere in the softmax/PV chain — so
+# the mp distribution is "each chip owns H/mp query heads and KVH/mp kv heads
+# of EVERY page".  The page pool shards on its KVH axis, q on its head axis,
+# and the page table / lengths / q_offset / valid scalars stay replicated
+# (they are host-side scheduler state, identical on every chip).  Two routes:
+# - Pallas (TPU): the kernel is grid-per-shard — shard_map_compat (the PR-1
+#   full-manual fallback on old JAX) runs the UNMODIFIED kernel on the local
+#   head slice of the pool.
+# - XLA oracle (CPU / kernel-unfriendly layouts): sharding constraints pin the
+#   head layout and GSPMD partitions the gather+einsum (the gather indexes the
+#   pool's page axis, which is unsharded, so it stays collective-free).
+_POOL_SPEC = P(None, None, "mp", None)      # [num_pages, page, KVH, hd]
+
+
+def _mp_degree(mesh) -> int:
+    if mesh is None:
+        return 1
+    return int(dict(mesh.shape).get("mp", 1))
+
+
+def _check_mp_heads(q_heads: int, kv_heads: int, mp: int) -> None:
+    if q_heads % mp or kv_heads % mp:
+        raise ValueError(
+            f"tensor-parallel serving needs num_heads ({q_heads}) and "
+            f"kv_heads ({kv_heads}) divisible by mp={mp}")
+
+
+def _head_spec(ndim: int) -> P:
+    """Shard the second-to-last ([..., H, hd]) axis over mp."""
+    return P(*([None] * (ndim - 2)), "mp", None)
+
+
+def _pin(mesh, x, spec):
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def paged_attention_decode_mp(q, k_pages, v_pages, page_table, lengths,
+                              mesh, scale=None, use_pallas=None,
+                              interpret=False):
+    """Head-sharded `paged_attention_decode` over the `mp` axis of `mesh`.
+
+    use_pallas=None auto-selects (TPU + kernel-friendly layout); tests force
+    True with interpret=True to run the shard_mapped kernel on CPU."""
+    from ...parallel.ring_attention import shard_map_compat
+
+    mp = _mp_degree(mesh)
+    _check_mp_heads(q.shape[1], k_pages.shape[2], mp)
+    if use_pallas is None:
+        use_pallas = _on_tpu() and _shapes_ok_for_pallas(q, k_pages)
+    if use_pallas:
+        def local(tbl, ln, q_l, k_l, v_l):
+            return paged_attention_pallas(q_l, k_l, v_l, tbl, ln, scale=scale,
+                                          interpret=interpret)
+        return shard_map_compat(
+            local, mesh=mesh, axis_names={"mp"},
+            in_specs=(P(None, None), P(None), _head_spec(3), _POOL_SPEC,
+                      _POOL_SPEC),
+            out_specs=_head_spec(3))(page_table, lengths, q, k_pages, v_pages)
+    q = _pin(mesh, q, _head_spec(3))
+    k_pages = _pin(mesh, k_pages, _POOL_SPEC)
+    v_pages = _pin(mesh, v_pages, _POOL_SPEC)
+    out = paged_attention_xla(q, k_pages, v_pages, page_table, lengths,
+                              scale=scale)
+    return _pin(mesh, out, _head_spec(3))
+
+
+def paged_prefill_attention_mp(q, k_pages, v_pages, page_table, q_offset,
+                               valid, mesh, scale=None, use_pallas=None,
+                               interpret=False):
+    """Head-sharded `paged_prefill_attention` (and, via
+    `paged_verify_attention`, the spec-decode verify lane) over `mp`."""
+    from ...parallel.ring_attention import shard_map_compat
+
+    mp = _mp_degree(mesh)
+    _check_mp_heads(q.shape[2], k_pages.shape[2], mp)
+    if use_pallas is None:
+        use_pallas = _on_tpu() and _shapes_ok_for_pallas(q, k_pages)
+    if use_pallas:
+        def local(tbl, qo, vl, q_l, k_l, v_l):
+            return paged_prefill_attention_pallas(q_l, k_l, v_l, tbl, qo, vl,
+                                                  scale=scale,
+                                                  interpret=interpret)
+        return shard_map_compat(
+            local, mesh=mesh, axis_names={"mp"},
+            in_specs=(P(None, None), P(None), P(None), _head_spec(4),
+                      _POOL_SPEC, _POOL_SPEC),
+            out_specs=_head_spec(4))(page_table, q_offset, valid, q, k_pages,
+                                     v_pages)
+    q = _pin(mesh, q, _head_spec(4))
+    k_pages = _pin(mesh, k_pages, _POOL_SPEC)
+    v_pages = _pin(mesh, v_pages, _POOL_SPEC)
+    out = paged_prefill_attention_xla(q, k_pages, v_pages, page_table,
+                                      q_offset, valid, scale=scale)
+    return _pin(mesh, out, _head_spec(4))
 
 
 def paged_attention_xla(q, k_pages, v_pages, page_table, lengths, scale=None):
@@ -333,9 +436,13 @@ def paged_prefill_attention_pallas(q, k_pages, v_pages, page_table, q_offset,
 
 
 def paged_prefill_attention(q, k_pages, v_pages, page_table, q_offset, valid,
-                            scale=None):
+                            scale=None, mesh=None):
     """Entry used by `models.gpt.prefill_chunk_paged`: Pallas on TPU when the
-    layout is kernel-friendly, gather fallback otherwise."""
+    layout is kernel-friendly, gather fallback otherwise.  mesh (with an 'mp'
+    axis > 1) runs head-sharded tensor-parallel."""
+    if _mp_degree(mesh) > 1:
+        return paged_prefill_attention_mp(q, k_pages, v_pages, page_table,
+                                          q_offset, valid, mesh, scale=scale)
     if _on_tpu() and _shapes_ok_for_pallas(q, k_pages):
         return paged_prefill_attention_pallas(q, k_pages, v_pages, page_table,
                                               q_offset, valid, scale=scale)
@@ -350,7 +457,7 @@ def _shapes_ok_for_pallas(q, k_pages):
 
 
 def paged_verify_attention(q, k_pages, v_pages, page_table, lengths, valid,
-                           scale=None):
+                           scale=None, mesh=None):
     """Entry used by `models.gpt.verify_step_paged`: multi-token (q_len > 1)
     decode over the paged pool.  q [B, T, H, hd] holds the last emitted token
     plus up to T-1 drafted tokens per slot; query t sits at absolute position
@@ -359,13 +466,17 @@ def paged_verify_attention(q, k_pages, v_pages, page_table, lengths, valid,
     the chunked-prefill pair with `q_offset = lengths` — one kernel serves
     both lanes, keeping the decode-side compiled-program count at two."""
     return paged_prefill_attention(q, k_pages, v_pages, page_table, lengths,
-                                   valid, scale=scale)
+                                   valid, scale=scale, mesh=mesh)
 
 
 def paged_attention_decode(q, k_pages, v_pages, page_table, lengths,
-                           scale=None):
+                           scale=None, mesh=None):
     """Entry used by `models.gpt.decode_step_paged`: Pallas on TPU when the
-    layout is kernel-friendly, gather fallback otherwise."""
+    layout is kernel-friendly, gather fallback otherwise.  mesh (with an 'mp'
+    axis > 1) runs head-sharded tensor-parallel."""
+    if _mp_degree(mesh) > 1:
+        return paged_attention_decode_mp(q, k_pages, v_pages, page_table,
+                                         lengths, mesh, scale=scale)
     if _on_tpu() and _shapes_ok_for_pallas(q, k_pages):
         return paged_attention_pallas(q, k_pages, v_pages, page_table, lengths,
                                       scale=scale)

@@ -52,6 +52,15 @@ and Orca's iteration-level scheduling (Yu et al., OSDI 2022), under the same
   one prefill chunk, runs one decode iteration over all fully-prefilled
   slots, and retires finished sequences (EOS or max_new_tokens), returning
   their pages to the refcounted pool.
+- **Multi-chip serving** (vLLM's Megatron-style tensor parallelism) —
+  `mp=N` shards the model over N chips: Megatron serving params placed once
+  at init (`parallel.hybrid.serving_param_specs`), page pool sharded on its
+  KVH axis (each chip holds kv_heads/mp heads of every page), paged
+  attention per-chip on the local head slice.  The scheduler and the cache
+  manager above are mp-oblivious — page tables/lengths/refcounts stay
+  replicated host state — and greedy outputs are token-identical to
+  single-chip serving.  Executables are AOT-compiled under mp (`_AotCache`)
+  so the per-mesh-config program budget stays exact.
 
 `bench_serve.py` replays a Poisson request stream through this engine and
 reports decode tokens/s/chip, TTFT percentiles, prefix-cache hit rate,
@@ -114,6 +123,8 @@ class _Running:
     cached_tokens: int = 0
     ttft_s: Optional[float] = None
     greedy: bool = True             # resolved request temperature == 0.0
+    spec_zero_streak: int = 0       # consecutive verify events accepting 0
+    spec_off: bool = False          # adaptive back-off: stop drafting
 
 
 @dataclasses.dataclass
@@ -134,6 +145,46 @@ def _pow2_buckets(lo: int, hi: int) -> List[int]:
         out.append(b)
         b *= 2
     return out
+
+
+class _AotCache:
+    """`jax.jit` replacement for the tensor-parallel serving path: one
+    `lower().compile()` per input signature (shape/dtype of every leaf),
+    cached here.
+
+    Why not plain jit: with donated, committed-sharded inputs (the mp pool),
+    jit's two dispatch layers (per-function fastpath + eval-path global cache)
+    each build the SAME program once — every serving executable showed two
+    XLA compilations and two cache entries for one program, which both wastes
+    a warmup compile per program and breaks the compiled-program budget that
+    `tools/check_program_count.py` enforces.  AOT-compiling keeps the program
+    set exact: `_cache_size()` is the number of DISTINCT programs, the number
+    the budget is about.  Inputs whose sharding diverges from the compiled
+    signature fail loudly instead of recompiling — under mp every input is
+    either host data (replicated) or pinned by the engine, so divergence is a
+    bug, not traffic.
+
+    skip_args: leading args excluded from the dispatch key — the params
+    pytree (placed once at init, its shapes can never change) would otherwise
+    be re-flattened into hundreds of (shape, dtype) tuples on every decode
+    dispatch."""
+
+    def __init__(self, fn, donate_argnums, skip_args=0):
+        self._jit = jax.jit(fn, donate_argnums=donate_argnums)
+        self._skip = skip_args
+        self._cache: Dict = {}
+
+    def __call__(self, *args):
+        key = tuple((x.shape, str(x.dtype))
+                    for x in jax.tree_util.tree_leaves(args[self._skip:]))
+        exe = self._cache.get(key)
+        if exe is None:
+            exe = self._jit.lower(*args).compile()
+            self._cache[key] = exe
+        return exe(*args)
+
+    def _cache_size(self) -> int:
+        return len(self._cache)
 
 
 class LLMEngine:
@@ -158,7 +209,21 @@ class LLMEngine:
     positions, and greedy longest-prefix acceptance emits 1..K+1 tokens per
     step with exact vanilla-decode token parity.  Drafting applies only to
     greedy slots — acceptance needs a deterministic pick — so sampled slots
-    keep the vanilla decode program.
+    keep the vanilla decode program.  `spec_backoff_window=W` (adaptive
+    spec_len, 0 disables): a slot whose drafts go W consecutive verify events
+    without a single accepted token stops being drafted for — it skips the
+    proposer scan and rides verify at valid=1 (`stats()["spec_backoffs"]`).
+
+    `mp=N` (or an explicit `mesh` with an 'mp' axis) serves tensor-parallel
+    over N chips: params are placed ONCE at init in the Megatron serving
+    layout (`parallel.hybrid.serving_param_specs` — qkv/fc1 column-, proj/fc2
+    row-sharded, embedding/head replicated), the page pool shards on its KVH
+    axis (each chip holds kv_heads/mp heads of every page), and the paged
+    attention runs per-chip on the local head slice.  All scheduler state
+    (page tables, lengths, refcounts, prefix index) stays replicated host
+    memory — the paging/prefix/COW logic is mp-oblivious — and greedy outputs
+    are token-identical to single-chip serving.  Per-mesh-config the compiled
+    decode-side program count is unchanged (<= 2).
     """
 
     def __init__(self, params, config: gpt_mod.GPTConfig, *,
@@ -172,7 +237,42 @@ class LLMEngine:
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  spec_len: int = 0,
                  draft_proposer: Optional[DraftProposer] = None,
+                 spec_backoff_window: int = 8,
+                 mesh=None, mp: Optional[int] = None,
                  seed: int = 0):
+        import jax.sharding as jsh
+
+        if mp is not None and mp > 1 and mesh is None:
+            from ..parallel.hybrid import serving_mesh
+            mesh = serving_mesh(mp)
+        self.mesh = mesh
+        self.mp = int(dict(mesh.shape).get("mp", 1)) if mesh is not None else 1
+        if self.mp > 1:
+            if config.num_heads % self.mp or config.kv_heads % self.mp:
+                raise ValueError(
+                    f"mp={self.mp} must divide num_heads "
+                    f"({config.num_heads}) and kv_heads ({config.kv_heads})")
+            # place the serving params ONCE at init: Megatron block layout,
+            # embedding/head replicated (parallel.hybrid.serving_param_specs)
+            from ..parallel.hybrid import serving_param_specs
+            specs = serving_param_specs(config, params)
+            self._param_shardings = jax.tree_util.tree_map(
+                lambda s: jsh.NamedSharding(mesh, s), specs,
+                is_leaf=lambda x: isinstance(x, jsh.PartitionSpec))
+            params = jax.device_put(params, self._param_shardings)
+            # page pool sharded on the KVH axis: every chip holds
+            # kv_heads/mp heads of EVERY page, so the host-side page tables /
+            # lengths / refcounts (inference.cache) stay replicated and the
+            # prefix-cache/COW/eviction logic is mp-oblivious.  NOTE the spec
+            # leaves the trailing hd dim implicit: executables re-derive the
+            # output sharding in this normalized form, and a trailing-None
+            # variant hashes as a DIFFERENT executable-cache key (one silent
+            # recompile per jit on the second call)
+            self._pool_sharding = jsh.NamedSharding(
+                mesh, jsh.PartitionSpec(None, None, None, "mp"))
+        else:
+            self._param_shardings = None
+            self._pool_sharding = None
         self.params = params
         self.config = config
         self.eos_token_id = eos_token_id
@@ -216,15 +316,28 @@ class LLMEngine:
         self.spec_len = spec_len
         self.proposer = (draft_proposer or NgramProposer()) if spec_len \
             else draft_proposer
+        if spec_backoff_window < 0:
+            raise ValueError(
+                f"spec_backoff_window must be >= 0, got {spec_backoff_window}")
+        self.spec_backoff_window = spec_backoff_window
         self.cache = PagedKVCache(num_pages, page_size, num_slots,
                                   max_pages_per_slot)
         self._pool = gpt_mod.init_paged_cache(config, num_pages, page_size)
+        if self._pool_sharding is not None:
+            self._pool = jax.device_put(
+                self._pool, {n: self._pool_sharding for n in self._pool})
         self._queue: deque = deque()
         self._running: Dict[int, _Running] = {}
         self._prefilling: Dict[int, _Prefilling] = {}   # slot -> state, FIFO
         self._free_slots = list(range(num_slots - 1, -1, -1))
         self._ids = itertools.count()
         self._key = jax.random.key(seed)
+        if self.mp > 1:
+            # commit the key to the mesh (replicated) up front: an uncommitted
+            # first-call key is a different executable-cache signature than the
+            # committed key every later call carries — one silent recompile
+            self._key = jax.device_put(
+                self._key, jsh.NamedSharding(mesh, jsh.PartitionSpec()))
         self._outputs: Dict[int, RequestOutput] = {}
 
         sample = bool(temperature and temperature > 0.0)
@@ -250,44 +363,69 @@ class LLMEngine:
                                             top_k=top_k)
 
         cfg = config
+        mesh_ = mesh if self.mp > 1 else None
+        pool_sh = self._pool_sharding
+
+        def pin_pool(pool):
+            # pin the output pool to EXACTLY the committed input sharding (the
+            # normalized spec): the donated buffer is reused in place and every
+            # call after the first carries an identical executable-cache
+            # signature — without the pin, GSPMD-inferred output shardings
+            # drift and decode/chunk ping-pong recompiles (4 chunk compiles
+            # observed for one engine)
+            if pool_sh is None:
+                return pool
+            return {n: jax.lax.with_sharding_constraint(a, pool_sh)
+                    for n, a in pool.items()}
 
         def decode_impl(params, tokens, pool, table, lengths, key, greedy):
             logits, pool = gpt_mod.decode_step_paged(params, tokens, pool,
-                                                     table, lengths, cfg)
+                                                     table, lengths, cfg,
+                                                     mesh=mesh_)
             nxt, key = pick(logits, key, greedy)
-            return nxt, pool, key
+            return nxt, pin_pool(pool), key
 
         def prefill_impl(params, ids, pool, pages, length, key, greedy):
             logits, pool = gpt_mod.prefill_paged(params, ids, cfg, pool,
-                                                 pages, length)
+                                                 pages, length, mesh=mesh_)
             first, key = pick(logits, key, greedy)
-            return first, pool, key
+            return first, pin_pool(pool), key
 
         def chunk_impl(params, ids, pool, table, q_offset, valid, key, greedy):
             logits, pool = gpt_mod.prefill_chunk_paged(params, ids, cfg, pool,
-                                                       table, q_offset, valid)
+                                                       table, q_offset, valid,
+                                                       mesh=mesh_)
             tok, key = pick(logits, key, greedy)
-            return tok, pool, key
+            return tok, pin_pool(pool), key
 
         def verify_impl(params, tokens, pool, table, lengths, valid):
             # greedy-only lane: acceptance compares argmax at every position,
             # no key threads through (spec parity requires determinism)
             logits, pool = gpt_mod.verify_step_paged(params, tokens, pool,
                                                      table, lengths, valid,
-                                                     cfg)
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), pool
+                                                     cfg, mesh=mesh_)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), \
+                pin_pool(pool)
 
         def copy_impl(pool, src, dst):
             # COW page copy: one [page, KVH, hd] slab per layer, src -> dst
-            return {n: a.at[:, dst].set(a[:, src]) for n, a in pool.items()}
+            # (page axis is unsharded, so the copy is collective-free under mp)
+            return pin_pool({n: a.at[:, dst].set(a[:, src])
+                             for n, a in pool.items()})
 
         # pool donated: each step updates it in place instead of copying the
-        # whole page pool every iteration
-        self._decode_fn = jax.jit(decode_impl, donate_argnums=(2,))
-        self._prefill_fn = jax.jit(prefill_impl, donate_argnums=(2,))
-        self._chunk_fn = jax.jit(chunk_impl, donate_argnums=(2,))
-        self._verify_fn = jax.jit(verify_impl, donate_argnums=(2,))
-        self._copy_fn = jax.jit(copy_impl, donate_argnums=(0,))
+        # whole page pool every iteration.  The mp path AOT-compiles (see
+        # _AotCache) so the program set stays exact under committed-sharded
+        # donated inputs; single-chip keeps plain jit.
+        jit_ = (lambda fn, donate, skip=0: _AotCache(fn, donate, skip)) \
+            if self.mp > 1 \
+            else (lambda fn, donate, skip=0:
+                  jax.jit(fn, donate_argnums=donate))
+        self._decode_fn = jit_(decode_impl, (2,), 1)    # skip=1: params static
+        self._prefill_fn = jit_(prefill_impl, (2,), 1)
+        self._chunk_fn = jit_(chunk_impl, (2,), 1)
+        self._verify_fn = jit_(verify_impl, (2,), 1)
+        self._copy_fn = jit_(copy_impl, (0,))
         self._seen_buckets = set()
         self._chunk_used = False
         self._copy_used = False
@@ -308,6 +446,7 @@ class LLMEngine:
         self._spec_drafted = 0          # drafted tokens offered to verify
         self._spec_accepted = 0         # drafted tokens accepted
         self._spec_emitted = 0          # accepted + bonus tokens emitted
+        self._spec_backoffs = 0         # slots that stopped drafting (adaptive)
         self.cache.prefix_evictions = 0
 
     # ---- request intake ---------------------------------------------------
@@ -536,6 +675,10 @@ class LLMEngine:
         for slot, seq in self._running.items():
             if not seq.greedy:
                 continue            # acceptance needs a deterministic pick
+            if seq.spec_off:
+                continue            # adaptive back-off: drafting never landed
+                                    # for this slot, skip the proposer scan
+                                    # (the slot rides verify at valid=1)
             cap = min(self.spec_len,
                       seq.request.max_new_tokens - len(seq.generated))
             if cap < 1:
@@ -609,6 +752,19 @@ class LLMEngine:
                 self._spec_drafted += nd
                 self._spec_accepted += a
                 self._spec_emitted += len(emitted)
+                # adaptive spec back-off: a slot whose drafts are NEVER
+                # accepted (acceptance rate ~0 over the window) stops paying
+                # the proposer scan and the wasted candidate positions — it
+                # keeps riding the verify program at valid=1.  Output parity
+                # is untouched: greedy acceptance is lossless either way.
+                if a == 0:
+                    seq.spec_zero_streak += 1
+                    if self.spec_backoff_window and not seq.spec_off and \
+                            seq.spec_zero_streak >= self.spec_backoff_window:
+                        seq.spec_off = True
+                        self._spec_backoffs += 1
+                else:
+                    seq.spec_zero_streak = 0
             if self._maybe_finish(seq, finished):
                 del self._running[slot]
 
@@ -721,12 +877,14 @@ class LLMEngine:
             "buckets": list(self.buckets),
             "prefill_chunk": self.prefill_chunk,
             "spec_len": self.spec_len,
+            "mp": self.mp,
             "decode_iterations": self._decode_iters,
             "decode_tokens": self._decode_tokens,
             "verify_steps": self._verify_steps,
             "spec_drafted_tokens": self._spec_drafted,
             "spec_accepted_tokens": self._spec_accepted,
             "spec_emitted_tokens": self._spec_emitted,
+            "spec_backoffs": self._spec_backoffs,
             # mean tokens emitted per drafted verify event (>= 1.0; 1.0 means
             # drafts never helped, spec_len+1 means every draft fully accepted)
             "accepted_per_step": self._spec_emitted / self._spec_events

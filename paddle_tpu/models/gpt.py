@@ -543,8 +543,9 @@ def init_cache(config: GPTConfig, batch: int, max_len: int):
     return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype)}
 
 
-def _ffn_dense(bp, h, c: GPTConfig):
-    """Dense-FFN body shared by the decode/prefill paths (gated + bias aware)."""
+def _ffn_dense(bp, h, c: GPTConfig, mp_constraint=None):
+    """Dense-FFN body shared by the decode/prefill paths (gated + bias aware).
+    mp_constraint (serving tensor parallel) pins the column-sharded hidden."""
     up = jnp.matmul(h, bp["fc1_w"])
     if "fc1_b" in bp:
         up = up + bp["fc1_b"]
@@ -553,8 +554,13 @@ def _ffn_dense(bp, h, c: GPTConfig):
         gate = jnp.matmul(h, bp["fcg_w"])
         if "fcg_b" in bp:
             gate = gate + bp["fcg_b"]
+        if mp_constraint:
+            up = mp_constraint(up, "ffn_mp")
+            gate = mp_constraint(gate, "ffn_mp")
         h = act(gate) * up
     else:
+        if mp_constraint:
+            up = mp_constraint(up, "ffn_mp")
         h = act(up)
     out = jnp.matmul(h, bp["fc2_w"])
     if "fc2_b" in bp:
@@ -622,10 +628,14 @@ def _prefill_qkv(bp, x, c: GPTConfig, pos=None):
     return q, k, v
 
 
-def _layer_tail(bp, x, attn, c: GPTConfig):
+def _layer_tail(bp, x, attn, c: GPTConfig, mp_constraint=None):
     """Shared post-attention half of a decode/prefill block: out-proj +
     residual (+ post-LN) + FFN/MoE + residual (+ post-LN).  attn is the
     head-flattened [..., D] attention output, x the block input (same rank)."""
+    if mp_constraint:
+        # head-sharded attention flattens to a column-sharded hidden; pinning
+        # it keeps the row-parallel proj matmul a local-contraction + psum
+        attn = mp_constraint(attn, "hidden_mp")
     attn = jnp.matmul(attn, bp["proj_w"])
     if "proj_b" in bp:
         attn = attn + bp["proj_b"]
@@ -640,7 +650,7 @@ def _layer_tail(bp, x, attn, c: GPTConfig):
         y, _ = moe_ffn_dense(bp, h.reshape(-1, c.hidden_size), c)
         y = y.reshape(*lead, c.hidden_size)
     else:
-        y = _ffn_dense(bp, h, c)
+        y = _ffn_dense(bp, h, c, mp_constraint)
     x = x + y
     if c.norm_position != "pre":
         x = _norm(x, bp["ln2_w"], bp["ln2_b"], c)
@@ -741,8 +751,30 @@ def init_paged_cache(config: GPTConfig, num_pages: int, page_size: int):
     return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype)}
 
 
+def serving_mp_constraint(mesh):
+    """Sharding-constraint callable for the tensor-parallel serving path
+    (multi-chip `LLMEngine`): pins activations so GSPMD partitions the paged
+    executables Megatron-style instead of guessing.  Kinds: "heads" shards the
+    second-to-last ([..., H|KVH, hd]) axis over mp (attention is per-head
+    independent); "ffn_mp"/"hidden_mp" column-shard the last axis.  Returns
+    None when mesh has no mp axis > 1, so call sites read
+    `if pin: x = pin(x, kind)` — zero-cost single chip."""
+    if mesh is None or int(dict(mesh.shape).get("mp", 1)) <= 1:
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def pin(x, kind):
+        if kind == "heads":
+            spec = P(*([None] * (x.ndim - 2)), "mp", None)
+        else:   # "hidden_mp" / "ffn_mp"
+            spec = P(*([None] * (x.ndim - 1)), "mp")
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+    return pin
+
+
 def decode_step_paged(params, tokens, cache, page_table, lengths,
-                      config: GPTConfig):
+                      config: GPTConfig, mesh=None):
     """Slot-indexed decode against the paged pool — ONE fixed-shape executable
     serves a churning request set (the continuous-batching hot loop).
 
@@ -753,6 +785,12 @@ def decode_step_paged(params, tokens, cache, page_table, lengths,
     to its own lengths[b] + 1 positions.  Inactive slots (lengths 0, all-null
     table row) compute garbage the scheduler ignores.
 
+    mesh (an 'mp' axis > 1) runs the step tensor-parallel: qkv/fc1 column- and
+    proj/fc2 row-sharded (`parallel.hybrid.serving_param_specs`), the page
+    pool sharded on its KVH axis (each chip holds num_heads/mp heads of every
+    page), attention head-sharded per chip; page tables and lengths stay
+    replicated host state.
+
     Returns (logits [B, V], updated cache).
     """
     from ..incubate.kernels.paged_attention import paged_attention_decode
@@ -761,6 +799,7 @@ def decode_step_paged(params, tokens, cache, page_table, lengths,
     B = tokens.shape[0]
     page = cache["k"].shape[2]
     pos = lengths
+    pin = serving_mp_constraint(mesh)
     x = jnp.take(params["wte"], tokens, axis=0)              # [B, D]
     if not c.use_rope:
         x = x + jnp.take(params["wpe"], pos, axis=0)
@@ -771,10 +810,13 @@ def decode_step_paged(params, tokens, cache, page_table, lengths,
     def layer(x, layer_in):
         bp, kc, vc = layer_in                        # pool [P, page, KVH, hd]
         q, k, v = _decode_qkv(bp, x, c, pos)
+        if pin:
+            q, k, v = pin(q, "heads"), pin(k, "heads"), pin(v, "heads")
         kc = kc.at[page_idx, offset].set(k)          # batched page scatter
         vc = vc.at[page_idx, offset].set(v)
-        attn = paged_attention_decode(q, kc, vc, page_table, pos + 1)
-        x = _layer_tail(bp, x, attn.reshape(B, c.hidden_size), c)
+        attn = paged_attention_decode(q, kc, vc, page_table, pos + 1,
+                                      mesh=mesh)
+        x = _layer_tail(bp, x, attn.reshape(B, c.hidden_size), c, pin)
         return x, (kc, vc)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -784,7 +826,8 @@ def decode_step_paged(params, tokens, cache, page_table, lengths,
     return jnp.matmul(x, head_matrix(params, c)), {"k": new_k, "v": new_v}
 
 
-def prefill_paged(params, input_ids, config: GPTConfig, cache, pages, length):
+def prefill_paged(params, input_ids, config: GPTConfig, cache, pages, length,
+                  mesh=None):
     """Bucketed paged prefill: one dense causal pass over the bucket-padded
     prompt that writes KV into the slot's pages and returns logits at the last
     REAL position (right padding is sound under causal attention: position
@@ -794,7 +837,9 @@ def prefill_paged(params, input_ids, config: GPTConfig, cache, pages, length):
     page ids (entries past the slot's reserved pages are the null page 0);
     length [B] int32 real prompt lengths.  Pool positions >= length hold
     padding garbage — masked by length during decode, overwritten as decode
-    appends real tokens.  Returns (logits [B, V], cache).
+    appends real tokens.  mesh: tensor-parallel over 'mp' (see
+    `decode_step_paged`); the dense flash attention runs per-shard over the
+    local head slice.  Returns (logits [B, V], cache).
     """
     c = config
     assert c.causal, "KV-cache decoding requires a causal model"
@@ -802,20 +847,36 @@ def prefill_paged(params, input_ids, config: GPTConfig, cache, pages, length):
     D, H, KVH, hd = c.hidden_size, c.num_heads, c.kv_heads, c.head_dim
     page = cache["k"].shape[2]
     n_chunks = Sb // page
+    pin = serving_mp_constraint(mesh)
     x = jnp.take(params["wte"], input_ids, axis=0)
     if not c.use_rope:
         x = x + params["wpe"][:Sb]
 
+    def attn_call(q, k, v):
+        if pin is None:
+            return flash_attention_fused(q, k, v, causal=True)
+        # attention never mixes heads: run the (Pallas or XLA) flash body
+        # per-shard on each chip's head slice — same trick as the paged lanes
+        from ..incubate.kernels.paged_attention import _head_spec
+        from ..parallel.ring_attention import shard_map_compat
+        hs = _head_spec(4)
+        return shard_map_compat(
+            lambda a, b, d: flash_attention_fused(a, b, d, causal=True),
+            mesh=mesh, axis_names={"mp"}, in_specs=(hs, hs, hs),
+            out_specs=hs)(q, k, v)
+
     def layer(x, layer_in):
         bp, kc, vc = layer_in
         q, k, v = _prefill_qkv(bp, x, c)
+        if pin:
+            q, k, v = pin(q, "heads"), pin(k, "heads"), pin(v, "heads")
         kc = kc.at[pages].set(k.reshape(B, n_chunks, page, KVH, hd))
         vc = vc.at[pages].set(v.reshape(B, n_chunks, page, KVH, hd))
         if KVH != H:
             k = jnp.repeat(k, H // KVH, axis=2)
             v = jnp.repeat(v, H // KVH, axis=2)
-        attn = flash_attention_fused(q, k, v, causal=True).reshape(B, Sb, D)
-        x = _layer_tail(bp, x, attn, c)
+        attn = attn_call(q, k, v).reshape(B, Sb, D)
+        x = _layer_tail(bp, x, attn, c, pin)
         return x, (kc, vc)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -827,7 +888,8 @@ def prefill_paged(params, input_ids, config: GPTConfig, cache, pages, length):
 
 
 def _paged_chunk_hidden(params, input_ids, config: GPTConfig, cache,
-                        page_table, q_offset, valid, attn_entry=None):
+                        page_table, q_offset, valid, attn_entry=None,
+                        mesh=None):
     """Shared trunk of the q_offset-masked paged passes (`prefill_chunk_paged`
     and `verify_step_paged`): embed a [B, C] token chunk starting at per-slot
     absolute position q_offset, write its KV token-granularly at
@@ -845,6 +907,7 @@ def _paged_chunk_hidden(params, input_ids, config: GPTConfig, cache,
     B, C = input_ids.shape
     D = c.hidden_size
     page = cache["k"].shape[2]
+    pin = serving_mp_constraint(mesh)
     pos = q_offset[:, None] + jnp.arange(C)                  # [B, C]
     real = jnp.arange(C)[None, :] < valid[:, None]           # [B, C]
     x = jnp.take(params["wte"], input_ids, axis=0)
@@ -859,10 +922,12 @@ def _paged_chunk_hidden(params, input_ids, config: GPTConfig, cache,
     def layer(x, layer_in):
         bp, kc, vc = layer_in
         q, k, v = _prefill_qkv(bp, x, c, pos=pos)
+        if pin:
+            q, k, v = pin(q, "heads"), pin(k, "heads"), pin(v, "heads")
         kc = kc.at[pidx, off].set(k)          # token-granular page scatter
         vc = vc.at[pidx, off].set(v)
-        attn = attn_fn(q, kc, vc, page_table, q_offset, valid)
-        x = _layer_tail(bp, x, attn.reshape(B, C, D), c)
+        attn = attn_fn(q, kc, vc, page_table, q_offset, valid, mesh=mesh)
+        x = _layer_tail(bp, x, attn.reshape(B, C, D), c, pin)
         return x, (kc, vc)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -872,7 +937,7 @@ def _paged_chunk_hidden(params, input_ids, config: GPTConfig, cache,
 
 
 def prefill_chunk_paged(params, input_ids, config: GPTConfig, cache,
-                        page_table, q_offset, valid):
+                        page_table, q_offset, valid, mesh=None):
     """Chunked paged prefill (Sarathi-style, Agrawal et al. OSDI 2024): one
     dense pass over a fixed-size chunk of the prompt starting at position
     q_offset, attending through the page table to everything already written
@@ -892,14 +957,14 @@ def prefill_chunk_paged(params, input_ids, config: GPTConfig, cache,
     """
     B = input_ids.shape[0]
     x, cache = _paged_chunk_hidden(params, input_ids, config, cache,
-                                   page_table, q_offset, valid)
+                                   page_table, q_offset, valid, mesh=mesh)
     x = x[jnp.arange(B), valid - 1]                  # last real chunk position
     x = epilogue(params, x, config)
     return jnp.matmul(x, head_matrix(params, config)), cache
 
 
 def verify_step_paged(params, tokens, cache, page_table, lengths, valid,
-                      config: GPTConfig):
+                      config: GPTConfig, mesh=None):
     """Speculative-decode verify (Leviathan et al. 2023): score spec_len + 1
     positions per slot in ONE fixed-shape executable — the multi-token sibling
     of `decode_step_paged`, riding the same q_offset-masked paged attention as
@@ -924,7 +989,8 @@ def verify_step_paged(params, tokens, cache, page_table, lengths, valid,
     from ..incubate.kernels.paged_attention import paged_verify_attention
     x, cache = _paged_chunk_hidden(params, tokens, config, cache,
                                    page_table, lengths, valid,
-                                   attn_entry=paged_verify_attention)
+                                   attn_entry=paged_verify_attention,
+                                   mesh=mesh)
     x = epilogue(params, x, config)
     return jnp.matmul(x, head_matrix(params, config)), cache
 

@@ -1,1 +1,2 @@
-from .hybrid import HybridParallelTrainer, MeshConfig  # noqa
+from .hybrid import (HybridParallelTrainer, MeshConfig,  # noqa
+                     serving_mesh, serving_param_specs)

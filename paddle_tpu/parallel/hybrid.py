@@ -126,6 +126,34 @@ def gpt_param_specs(cfg: MeshConfig, model_config=None):
     return specs
 
 
+def serving_mesh(mp: int, devices=None) -> Mesh:
+    """1-D tensor-parallel mesh for the serving engine: the first `mp` devices
+    on an ("mp",) axis — the decode path has no batch/pipeline dimension worth
+    sharding (num_slots is small and latency-critical), so serving uses a pure
+    Megatron mp slice of the machine."""
+    devs = np.array(devices if devices is not None else jax.devices()[:mp])
+    assert devs.size >= mp, f"need {mp} devices for mp serving, have {devs.size}"
+    return Mesh(devs[:mp], ("mp",))
+
+
+def serving_param_specs(model_config, params):
+    """PartitionSpec tree (congruent with `params`) for tensor-parallel
+    serving: the trainer's Megatron block layout (`gpt_param_specs` with the
+    pp/ep axes off — qkv/fc1/fcg column-split, proj/fc2 row-split) over an
+    ("mp",) serving mesh, with the embedding/head/final-norm replicated.
+
+    Replicating the vocab table is deliberate: the serving path samples from
+    full [B, V] logits on the host every step, and a vocab-sharded head would
+    put an allgather (or a distributed argmax) on the latency-critical decode
+    dispatch; the transformer blocks — the bulk of the params at depth — are
+    what mp-sharding is for (per-chip block memory drops by mp×)."""
+    base = gpt_param_specs(MeshConfig(mp=2), model_config)["blocks"]
+    blocks = {k: base.get(k, P()) for k in params["blocks"]}
+    specs = {k: P() for k in params if k != "blocks"}
+    specs["blocks"] = blocks
+    return specs
+
+
 def _add_axis(spec: P, shape, axis_name: str, degree: int) -> P:
     """Shard `axis_name` onto the first unsharded, divisible dim of `shape`."""
     flat = [a for e in spec if e is not None
@@ -426,10 +454,23 @@ def _pp_loss(params, tokens, labels, config, cfg: MeshConfig, mesh):
         assert config.num_layers % (Ppp * vpp) == 0, \
             f"layers {config.num_layers} must divide over pp*vpp"
         # chunk c of stage p = layers [(c*Ppp + p) * Lc, ...): reshape the
-        # stacked layer axis to [vpp, Ppp, Lc] and shard the Ppp axis
-        blocks_arg = jax.tree_util.tree_map(
-            lambda a: a.reshape((vpp, Ppp, a.shape[0] // (vpp * Ppp))
-                                + a.shape[1:]), params["blocks"])
+        # stacked layer axis to [vpp, Ppp, Lc] and shard the Ppp axis.  The
+        # reshape INTERLEAVES layers across the new dims, so the params' at-rest
+        # (pp, ..., mp) sharding cannot be pushed through it — the partitioner
+        # used to fall back to involuntary full rematerialization (the [SPMD]
+        # warnings in MULTICHIP_r03.json).  Stage it explicitly instead:
+        # allgather to replicated, reshape, reslice onto pp — each transition
+        # is one the partitioner lowers efficiently.  The mp allgather is not
+        # extra work: the shard_map below consumes P(None, "pp") inputs, so
+        # axes outside pp were ALWAYS replicated at this boundary (the PR-1
+        # full-manual fallback computes redundantly per mp rank by design).
+        def _vpp_reshape(a):
+            a = jax.lax.with_sharding_constraint(a, NamedSharding(mesh, P()))
+            a = a.reshape((vpp, Ppp, a.shape[0] // (vpp * Ppp)) + a.shape[1:])
+            return jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, P(None, "pp")))
+
+        blocks_arg = jax.tree_util.tree_map(_vpp_reshape, params["blocks"])
         T = vpp * M + Ppp - 1
     else:
         blocks_arg = params["blocks"]

@@ -407,3 +407,86 @@ def test_check_program_count_tool():
     assert got["decode_side_executables"] <= cpc.BUDGET["decode_side_executables"]
     assert got["total_executables"] <= cpc.BUDGET["total_executables"]
     assert stats["accepted_per_step"] > 1.0
+    # per-mesh-config budget: the mp=2 tensor-parallel pass replays the same
+    # stream within the mp budget and emits byte-identical greedy tokens
+    got_mp, stats_mp = cpc.measure(mp=2)
+    assert got_mp["decode_side_executables"] <= \
+        cpc.BUDGET_MP["decode_side_executables"]
+    assert got_mp["total_executables"] <= cpc.BUDGET_MP["total_executables"]
+    assert stats_mp["outputs_digest"] == stats["outputs_digest"]
+
+
+# ---------------------------------------------------------------------------
+# adaptive spec back-off (per-slot)
+# ---------------------------------------------------------------------------
+
+class _AlwaysWrongProposer:
+    """Drafts a constant token stream the tiny random model never emits, so
+    acceptance is exactly 0 on every verify event."""
+    max_lookback = 4
+
+    def __init__(self, token):
+        self.token = token
+        self.calls = 0
+
+    def propose(self, context, max_tokens):
+        self.calls += 1
+        return np.full((max_tokens,), self.token, np.int32)
+
+
+def test_adaptive_spec_backoff_stops_dead_drafting(tiny):
+    """A slot whose drafts are never accepted stops being proposed for after
+    `spec_backoff_window` zero-accept verify events: the proposer is no
+    longer scanned for it, drafted-token counters freeze, the back-off shows
+    in stats(), and the emitted tokens are STILL exactly the vanilla greedy
+    stream (acceptance is lossless either way)."""
+    cfg, params = tiny
+    rng = np.random.RandomState(2)
+    prompt = rng.randint(0, cfg.vocab_size, (6,)).astype(np.int32)
+    W, NEW = 3, 24
+
+    base = LLMEngine(params, cfg, num_slots=1, page_size=8, max_model_len=64,
+                     spec_len=0)
+    base.add_request(prompt, max_new_tokens=NEW)
+    ref = next(iter(base.run().values())).token_ids
+
+    # pick a draft token the greedy stream never contains -> 0% acceptance
+    bad = next(t for t in range(cfg.vocab_size) if t not in ref)
+    prop = _AlwaysWrongProposer(bad)
+    eng = LLMEngine(params, cfg, num_slots=1, page_size=8, max_model_len=64,
+                    spec_len=3, draft_proposer=prop, spec_backoff_window=W)
+    eng.add_request(prompt, max_new_tokens=NEW)
+    out = next(iter(eng.run().values())).token_ids
+    st = eng.stats()
+    assert out == ref                         # parity regardless of back-off
+    assert st["spec_backoffs"] == 1           # the slot backed off once
+    # exactly W drafted events of spec_len tokens, then drafting stopped
+    assert prop.calls == W
+    assert st["spec_drafted_tokens"] == W * 3
+    assert st["spec_accepted_tokens"] == 0
+    eng.cache.check_invariants()
+
+    # window=0 disables the back-off: the proposer is scanned every iteration
+    prop2 = _AlwaysWrongProposer(bad)
+    eng2 = LLMEngine(params, cfg, num_slots=1, page_size=8, max_model_len=64,
+                     spec_len=3, draft_proposer=prop2, spec_backoff_window=0)
+    eng2.add_request(prompt, max_new_tokens=NEW)
+    out2 = next(iter(eng2.run().values())).token_ids
+    assert out2 == ref
+    assert eng2.stats()["spec_backoffs"] == 0
+    assert prop2.calls > W
+
+
+def test_adaptive_spec_backoff_resets_on_acceptance(tiny):
+    """Accepted drafts reset the zero-accept streak: an NgramProposer on a
+    repetitive greedy stream keeps drafting (no back-off) while emitting the
+    exact vanilla tokens."""
+    cfg, params = tiny
+    prompt = np.asarray([9, 9, 9, 9, 9, 9], np.int32)   # tight loop
+    eng = LLMEngine(params, cfg, num_slots=1, page_size=8, max_model_len=64,
+                    spec_len=3, spec_backoff_window=2)
+    eng.add_request(prompt, max_new_tokens=16)
+    eng.run()
+    st = eng.stats()
+    if st["spec_accepted_tokens"] > 0:        # stream-dependent, usually true
+        assert st["spec_backoffs"] == 0
