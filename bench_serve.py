@@ -37,9 +37,20 @@ token-identical to single-chip, and `decode_tokens_per_sec_per_chip` divides
 by N.  On CPU, simulate the chips:
 `XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \\
     python bench_serve.py --mp 2` (set automatically when absent).
+
+Latency percentiles (TTFT/TPOT/queue-time/e2e, p50/p99 ms) come from the
+ENGINE's lifecycle histograms (`stats()["latency"]`), not a bench-side list —
+the same numbers a Prometheus scrape of `engine.metrics` would see — and the
+full metrics snapshot rides in the JSON under "metrics".  `--trace-dir D`
+wraps the timed section in `engine.trace(D, device=False)`: chrome-trace of
+the engine's host phases + per-step timeline + metrics dump.  Host-side
+only — a jax device capture over a whole bench run would dominate the timed
+section; for a device timeline, wrap a short window in `engine.trace(dir)`
+directly (device capture is its default).
 """
 from __future__ import annotations
 
+import contextlib
 import json
 import time
 
@@ -50,7 +61,8 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
                     page_size=8, max_model_len=None, max_new_tokens=8,
                     request_rate=float("inf"), seed=0, params=None,
                     prefill_chunk=None, prefix_cache=True,
-                    shared_prefix_frac=0.0, spec_len=0, mp=1):
+                    shared_prefix_frac=0.0, spec_len=0, mp=1,
+                    trace_dir=None):
     """Replay a Poisson request stream through LLMEngine; returns the metrics
     dict (also the CI smoke entrypoint — tests assert on the executable
     counts, the prefix-cache hit rate and the speculative acceptance rate).
@@ -140,23 +152,33 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
     eng.warm_spec()                     # verify executable (no-op spec off)
     eng.reset_counters()
 
-    t0 = time.perf_counter()
     pending = list(zip(arrivals, prompts))
     outs = []
-    while pending or eng.has_work:
-        now = time.perf_counter() - t0
-        while pending and pending[0][0] <= now:
-            _, p = pending.pop(0)
-            eng.add_request(p, max_new_tokens=max_new_tokens)
-        if eng.has_work:
-            outs.extend(eng.step())
-        elif pending:
-            time.sleep(min(pending[0][0] - now, 0.01))
-    dt = time.perf_counter() - t0
+    # host-side capture only (spans + step timeline + metrics): a jax device
+    # capture over a whole bench run would dominate the timed section and
+    # turn the headline tokens/s into a profiler benchmark — for device
+    # timelines, wrap a short window in `engine.trace(dir)` directly
+    trace_ctx = eng.trace(trace_dir, device=False) if trace_dir \
+        else contextlib.nullcontext()
+    with trace_ctx:
+        # clock starts AFTER trace-context entry (mkdir + profiler start) and
+        # stops BEFORE its exit (trace serialization): capture setup/teardown
+        # must not count against the traced pass's tokens/s
+        t0 = time.perf_counter()
+        while pending or eng.has_work:
+            now = time.perf_counter() - t0
+            while pending and pending[0][0] <= now:
+                _, p = pending.pop(0)
+                eng.add_request(p, max_new_tokens=max_new_tokens)
+            if eng.has_work:
+                outs.extend(eng.step())
+            elif pending:
+                time.sleep(min(pending[0][0] - now, 0.01))
+        dt = time.perf_counter() - t0
     assert len(outs) == num_requests, (len(outs), num_requests)
 
     st = eng.stats()
-    ttft = np.asarray([o.ttft_s for o in outs if o.ttft_s is not None])
+    lat = st["latency"]     # engine-side lifecycle histograms, seconds
     # EMITTED decode tokens only — idle slots in ramp-up/drain iterations are
     # not useful work and would overstate throughput at low arrival rates
     # (with spec on, an accepted draft emits several tokens per slot-step)
@@ -177,8 +199,14 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
         "generated_tokens_per_sec": round(num_requests * max_new_tokens / dt, 1),
         "requests": num_requests,
         "elapsed_s": round(dt, 3),
-        "ttft_p50_ms": round(float(np.percentile(ttft, 50)) * 1e3, 2),
-        "ttft_p99_ms": round(float(np.percentile(ttft, 99)) * 1e3, 2),
+        "ttft_p50_ms": round(lat["ttft_s"]["p50"] * 1e3, 2),
+        "ttft_p99_ms": round(lat["ttft_s"]["p99"] * 1e3, 2),
+        "tpot_p50_ms": round(lat["tpot_s"]["p50"] * 1e3, 2),
+        "tpot_p99_ms": round(lat["tpot_s"]["p99"] * 1e3, 2),
+        "queue_p50_ms": round(lat["queue_s"]["p50"] * 1e3, 2),
+        "queue_p99_ms": round(lat["queue_s"]["p99"] * 1e3, 2),
+        "e2e_p50_ms": round(lat["e2e_s"]["p50"] * 1e3, 2),
+        "e2e_p99_ms": round(lat["e2e_s"]["p99"] * 1e3, 2),
         "prefix_hit_rate": round(st["prefix_hit_rate"], 4),
         "prefix_cached_tokens": st["prefix_cached_tokens"],
         "prefilled_tokens": st["prefilled_tokens"],
@@ -195,12 +223,17 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
         "shared_prefix_frac": shared_prefix_frac,
         "spec_len": spec_len,
         "verify_steps": st["verify_steps"],
+        "spec_events": st["spec_events"],
         "accepted_per_step": round(st["accepted_per_step"], 3),
         "spec_drafted_tokens": st["spec_drafted_tokens"],
         "spec_accepted_tokens": st["spec_accepted_tokens"],
         "outputs_digest": digest.hexdigest(),
         "kv_token_capacity": st["kv_token_capacity"],
         "dense_token_footprint": st["dense_token_footprint"],
+        "trace_dir": trace_dir,
+        # full registry snapshot (counters/gauges/histogram summaries) — the
+        # scrape-shaped view, embedded so a bench JSON is self-contained
+        "metrics": eng.metrics.snapshot(),
     }
 
 
@@ -229,6 +262,12 @@ def main():
                          "spec-off comparison pass)")
     ap.add_argument("--request-rate", type=float, default=None,
                     help="Poisson arrival rate in req/s (default: offline)")
+    ap.add_argument("--trace-dir", type=str, default=None,
+                    help="capture the timed section into this directory: "
+                         "chrome trace of engine host phases + per-step "
+                         "timeline + metrics dump (host-side only — for a "
+                         "jax device capture wrap a short window in "
+                         "engine.trace(dir) directly); main pass only")
     args = ap.parse_args()
     if args.request_rate is not None and args.request_rate <= 0:
         ap.error("--request-rate must be > 0")
@@ -270,10 +309,11 @@ def main():
                   request_rate=float("inf") if args.request_rate is None
                   else args.request_rate)
         metric = "serve_decode_tokens_per_sec (cpu smoke)"
-    stats = run_serve_bench(spec_len=spec_len, **kw)
+    stats = run_serve_bench(spec_len=spec_len, trace_dir=args.trace_dir, **kw)
     if spec_len:
         # spec on/off delta on the SAME stream: greedy acceptance is lossless,
         # so the digests must match and the tokens/s ratio is the honest win
+        # (comparison pass untraced: tracing overhead must not skew the ratio)
         base = run_serve_bench(spec_len=0, **kw)
         stats["no_spec_decode_tokens_per_sec_per_chip"] = \
             base["decode_tokens_per_sec_per_chip"]

@@ -190,8 +190,12 @@ def convert_to_mixed_precision(*a, **k):
 # Serving engine (continuous batching + paged KV cache) — lazy so importing
 # paddle_tpu.inference does not pull the model zoo in.
 _SERVING = {"LLMEngine": "engine", "Request": "engine",
-            "RequestOutput": "engine", "PagedKVCache": "cache",
-            "DraftProposer": "spec", "NgramProposer": "spec"}
+            "RequestOutput": "engine", "RequestMetrics": "engine",
+            "PagedKVCache": "cache",
+            "DraftProposer": "spec", "NgramProposer": "spec",
+            "MetricsRegistry": "metrics", "Counter": "metrics",
+            "Gauge": "metrics", "Histogram": "metrics",
+            "log_buckets": "metrics"}
 
 
 def __getattr__(name):
@@ -204,5 +208,7 @@ def __getattr__(name):
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "PlaceType", "get_version", "convert_to_mixed_precision",
-           "LLMEngine", "Request", "RequestOutput", "PagedKVCache",
-           "DraftProposer", "NgramProposer"]
+           "LLMEngine", "Request", "RequestOutput", "RequestMetrics",
+           "PagedKVCache", "DraftProposer", "NgramProposer",
+           "MetricsRegistry", "Counter", "Gauge", "Histogram",
+           "log_buckets"]

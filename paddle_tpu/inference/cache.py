@@ -80,6 +80,7 @@ class PagedKVCache:
         self._lru: "OrderedDict[int, _PrefixNode]" = OrderedDict()
         self._node_ids = itertools.count(1)
         self.prefix_evictions = 0
+        self._evictions_counter = None      # metrics mirror, see attach_metrics
 
     # ---- capacity queries -------------------------------------------------
     @property
@@ -119,6 +120,24 @@ class PagedKVCache:
         """Pool capacity in tokens (excludes the null page) — the number the
         engine's memory claim is measured against (vs num_slots * max_len)."""
         return (self.num_pages - 1) * self.page_size
+
+    def attach_metrics(self, registry) -> None:
+        """Register page-accounting observability on a
+        `inference.metrics.MetricsRegistry`: pull gauges over the free/in-use/
+        evictable partition (evaluated only at scrape/snapshot time — the
+        allocator hot path pushes nothing) and a monotonic counter mirroring
+        `prefix_evictions` (the int attribute stays authoritative for
+        `stats()`; the counter is the Prometheus face of the same events)."""
+        self._evictions_counter = registry.counter(
+            "prefix_evictions", "cached prefix pages reclaimed under pressure")
+        registry.gauge("kv_pages_in_use", self.pages_in_use,
+                       "pages with refcount > 0")
+        registry.gauge("kv_pages_free", lambda: self.num_free_pages,
+                       "pages immediately allocatable")
+        registry.gauge("kv_pages_evictable", lambda: self.num_evictable_pages,
+                       "refcount-0 cached prefix pages, reclaimable on demand")
+        registry.gauge("prefix_cached_pages", lambda: len(self._index),
+                       "pages registered in the prefix index")
 
     # ---- prefix index -----------------------------------------------------
     def _match(self, tokens: np.ndarray
@@ -189,6 +208,8 @@ class PagedKVCache:
             del self._page_node[node.page]
             self._free.append(node.page)
             self.prefix_evictions += 1
+            if self._evictions_counter is not None:
+                self._evictions_counter.inc()
 
     # ---- slot lifecycle ---------------------------------------------------
     def allocate(self, slot: int, total_tokens: int) -> np.ndarray:
@@ -280,8 +301,11 @@ class PagedKVCache:
 
     def pages_in_use(self) -> int:
         """Distinct pages with refcount > 0 (cached-but-unreferenced prefixes
-        do not count — they are reclaimable)."""
-        return int((self._ref > 0).sum())
+        do not count — they are reclaimable).  O(1) via the free/LRU/in-use
+        partition over the real pages (asserted by check_invariants) — this
+        runs on the scheduler hot path every step for the trace ring, so it
+        must not scan refcounts on a production-sized pool."""
+        return self.num_pages - 1 - len(self._free) - len(self._lru)
 
     def check_invariants(self) -> None:
         """Assert the refcount/free-list/LRU partition is consistent — every
@@ -305,6 +329,8 @@ class PagedKVCache:
             "page in more than one of free/LRU/in-use"
         assert free | lru | used == set(range(1, self.num_pages)), \
             "page leaked out of free/LRU/in-use partition"
+        assert self.pages_in_use() == len(used), \
+            "O(1) pages_in_use diverged from the refcount scan"
         for node in self._lru.values():
             assert self._index.get(node.key) is node, "LRU node unregistered"
         for page, node in self._page_node.items():

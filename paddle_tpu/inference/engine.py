@@ -62,24 +62,47 @@ and Orca's iteration-level scheduling (Yu et al., OSDI 2022), under the same
   single-chip serving.  Executables are AOT-compiled under mp (`_AotCache`)
   so the per-mesh-config program budget stays exact.
 
+- **Observability** (Orca/vLLM-style serving metrics over the repo's own
+  profiler subsystem) — every engine counter lives in a
+  `inference.metrics.MetricsRegistry` (`engine.metrics`): Prometheus text
+  exposition via `metrics.to_prometheus()`, JSON via `metrics.snapshot()`,
+  and the flat `stats()` dict unchanged on top.  Each request is stamped at
+  enqueue/admission/first-token/finish, feeding queue-time, TTFT, TPOT and
+  e2e-latency histograms plus a per-request `RequestOutput.metrics` record
+  (abort and prefix-hit paths included).  `step()` appends one record per
+  iteration to a bounded ring (`step_trace()`): decode-batch occupancy,
+  chunk interleave, verify dispatches, tokens emitted, page-pool levels —
+  the victim-selection signal the ROADMAP's preemption work needs.
+  `engine.trace(dir)` wraps a serving window in `profiler.RecordEvent` spans
+  around the host phases (admit, chunk dispatch, proposer scan, verify/decode
+  dispatch, acceptance, sample sync), exports them as a chrome trace next to
+  the step timeline and a metrics dump, and starts/stops a `jax.profiler`
+  device capture when available.  Instrumentation is host-only: zero new
+  compiled programs, spans skipped entirely unless a trace is recording.
+
 `bench_serve.py` replays a Poisson request stream through this engine and
 reports decode tokens/s/chip, TTFT percentiles, prefix-cache hit rate,
 accepted tokens per verify step and compiled-program counts.
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import itertools
+import json
+import os
 import time
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..models import gpt as gpt_mod
+from ..profiler import profiler as _prof
 from .cache import PagedKVCache
+from .metrics import MetricsRegistry
 from .spec import DraftProposer, NgramProposer
 
 
@@ -99,6 +122,27 @@ class Request:
 
 
 @dataclasses.dataclass
+class RequestMetrics:
+    """Wall-clock lifecycle of one request, stamped with the engine clock
+    (injectable, monotonic — absolute fields are engine-clock readings, not
+    epoch time).  Answers "why was this request slow" after the fact: a large
+    `queue_s` is admission pressure (pages or slots), a large `ttft_s` with a
+    small `queue_s` is prefill cost, a large `tpot_s` is decode contention.
+    Stage stamps are None for stages the request never reached (an abort
+    while queued has only t_enqueue/t_finish)."""
+    t_enqueue: float
+    t_admit: Optional[float] = None         # popped from the queue into a slot
+    t_first_token: Optional[float] = None   # joined the decode set
+    t_finish: Optional[float] = None        # retired (stop/length/abort)
+    queue_s: Optional[float] = None         # t_admit - t_enqueue
+    ttft_s: Optional[float] = None          # t_first_token - t_enqueue
+    tpot_s: Optional[float] = None          # decode time per token after first
+    e2e_s: Optional[float] = None           # t_finish - t_enqueue
+    cached_tokens: int = 0                  # prompt tokens from the prefix cache
+    n_generated: int = 0
+
+
+@dataclasses.dataclass
 class RequestOutput:
     request_id: int
     prompt: np.ndarray
@@ -106,6 +150,7 @@ class RequestOutput:
     finish_reason: str              # "stop" (EOS) | "length" (budget) | "abort"
     cached_tokens: int = 0          # prompt tokens served from the prefix cache
     ttft_s: Optional[float] = None  # enqueue -> first generated token
+    metrics: Optional[RequestMetrics] = None    # full lifecycle record
 
     @property
     def tokens(self) -> np.ndarray:
@@ -145,6 +190,39 @@ def _pow2_buckets(lo: int, hi: int) -> List[int]:
         out.append(b)
         b *= 2
     return out
+
+
+class _NullSpan:
+    """Stand-in for `profiler.RecordEvent` when nothing is recording: the
+    decode loop enters a span per host phase per step, so the off state must
+    cost one attribute read and an empty context manager, not a
+    perf_counter_ns + TraceAnnotation pair."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+# Host-phase span names `engine.trace()` emits into the chrome trace — one
+# tuple so tests and dashboards don't chase string literals through the
+# scheduler.  admit covers prefix matching + reservation (+ the one-shot
+# bucketed prefill when taken synchronously); dispatch spans end when the
+# async call returns, sample/accept spans contain the blocking device sync.
+ENGINE_SPANS = (
+    "engine.step",
+    "engine.admit",
+    "engine.prefill.dispatch",
+    "engine.spec.propose",
+    "engine.verify.dispatch",
+    "engine.spec.accept",
+    "engine.decode.dispatch",
+    "engine.sample.sync",
+)
 
 
 class _AotCache:
@@ -214,6 +292,14 @@ class LLMEngine:
     without a single accepted token stops being drafted for — it skips the
     proposer scan and rides verify at valid=1 (`stats()["spec_backoffs"]`).
 
+    Observability: `engine.metrics` is the metrics registry (counters,
+    page/queue gauges, latency histograms; `to_prometheus()` for scraping),
+    `stats()` the flat dict benches consume, `step_trace()` the per-iteration
+    ring timeline (`trace_ring` entries), and `engine.trace(dir)` a capture
+    window writing chrome-trace + timeline + metrics dumps.  `clock` injects
+    the monotonic clock behind every lifecycle stamp (default
+    `time.perf_counter`) so tests drive deterministic latencies.
+
     `mp=N` (or an explicit `mesh` with an 'mp' axis) serves tensor-parallel
     over N chips: params are placed ONCE at init in the Megatron serving
     layout (`parallel.hybrid.serving_param_specs` — qkv/fc1 column-, proj/fc2
@@ -239,7 +325,9 @@ class LLMEngine:
                  draft_proposer: Optional[DraftProposer] = None,
                  spec_backoff_window: int = 8,
                  mesh=None, mp: Optional[int] = None,
-                 seed: int = 0):
+                 seed: int = 0,
+                 clock: Optional[Callable[[], float]] = None,
+                 trace_ring: int = 512):
         import jax.sharding as jsh
 
         if mp is not None and mp > 1 and mesh is None:
@@ -340,6 +428,64 @@ class LLMEngine:
                 self._key, jsh.NamedSharding(mesh, jsh.PartitionSpec()))
         self._outputs: Dict[int, RequestOutput] = {}
 
+        # ---- observability state (all host-side: no executable sees any of
+        # this, so the compiled-program budget is untouched) ----------------
+        if trace_ring < 1:
+            raise ValueError(f"trace_ring must be >= 1, got {trace_ring}")
+        m = MetricsRegistry(namespace="llm_engine",
+                            clock=clock or time.perf_counter)
+        self.metrics = m
+        self._now = m.now
+        self._decode_iters = m.counter("decode_iterations",
+                                       "decode-side engine iterations")
+        self._decode_tokens = m.counter("decode_tokens",
+                                        "tokens emitted by decode/verify")
+        self._prefill_chunks = m.counter("prefill_chunks",
+                                         "chunk-prefill dispatches")
+        self._prefilled_tokens = m.counter("prefilled_tokens",
+                                           "prompt tokens actually computed")
+        self._prefix_cached_tokens = m.counter(
+            "prefix_cached_tokens", "prompt tokens served from the cache")
+        self._prefix_hit_requests = m.counter(
+            "prefix_hit_requests", "requests admitted with a prefix hit")
+        self._cow_copies = m.counter("cow_page_copies",
+                                     "copy-on-write page copies")
+        self._verify_steps = m.counter("verify_steps",
+                                       "verify-program dispatches")
+        self._spec_events = m.counter(
+            "spec_events", "per-slot verify events carrying a draft")
+        self._spec_drafted = m.counter("spec_drafted_tokens",
+                                       "drafted tokens offered to verify")
+        self._spec_accepted = m.counter("spec_accepted_tokens",
+                                        "drafted tokens accepted")
+        self._spec_emitted = m.counter(
+            "spec_emitted_tokens", "accepted + bonus tokens emitted")
+        self._spec_backoffs = m.counter(
+            "spec_backoffs", "slots that stopped drafting (adaptive back-off)")
+        self._finished_requests = m.counter(
+            "finished_requests", "requests retired by stop/length")
+        self._aborted_requests = m.counter("aborted_requests",
+                                           "requests retired by abort()")
+        self._h_queue = m.histogram("queue_time_seconds",
+                                    help="enqueue -> admission into a slot")
+        self._h_ttft = m.histogram("ttft_seconds",
+                                   help="enqueue -> first generated token")
+        self._h_tpot = m.histogram(
+            "tpot_seconds", help="decode seconds per token after the first")
+        self._h_e2e = m.histogram("e2e_latency_seconds",
+                                  help="enqueue -> finish (stop/length only)")
+        self._h_step = m.histogram("step_seconds",
+                                   help="wall time of one engine step()")
+        m.gauge("queued", lambda: len(self._queue), "requests waiting")
+        m.gauge("prefilling", lambda: len(self._prefilling),
+                "slots mid-prefill")
+        m.gauge("running", lambda: len(self._running), "slots decoding")
+        self.cache.attach_metrics(m)
+        self._lifecycles: Dict[int, RequestMetrics] = {}
+        self._step_idx = 0
+        self._step_trace: deque = deque(maxlen=trace_ring)
+        self._tracing = False
+
         sample = bool(temperature and temperature > 0.0)
         self._sample = sample
         self._temperature = temperature
@@ -432,22 +578,16 @@ class LLMEngine:
         self.reset_counters()
 
     def reset_counters(self) -> None:
-        """Zero the throughput/prefix counters (stats(), not executables) —
-        benches call this after warmup so compile-time traffic is excluded."""
-        self._decode_iters = 0
-        self._decode_tokens = 0         # tokens EMITTED by decode/verify steps
-        self._prefill_chunks = 0
-        self._prefilled_tokens = 0      # prompt tokens actually computed
-        self._prefix_cached_tokens = 0  # prompt tokens served from the cache
-        self._prefix_hit_requests = 0
-        self._cow_copies = 0
-        self._verify_steps = 0          # verify-program dispatches
-        self._spec_events = 0           # per-slot verify events WITH a draft
-        self._spec_drafted = 0          # drafted tokens offered to verify
-        self._spec_accepted = 0         # drafted tokens accepted
-        self._spec_emitted = 0          # accepted + bonus tokens emitted
-        self._spec_backoffs = 0         # slots that stopped drafting (adaptive)
+        """Zero the throughput/prefix counters and latency histograms
+        (stats(), not executables) — benches call this after warmup so
+        compile-time traffic is excluded.  Also clears the step-trace ring and
+        the proposer's drafting telemetry; the `prefix_evictions` int mirrors
+        its registry counter so both zero together."""
+        self.metrics.reset()
         self.cache.prefix_evictions = 0
+        getattr(self.proposer, "reset_stats", lambda: None)()
+        self._step_idx = 0
+        self._step_trace.clear()
 
     # ---- request intake ---------------------------------------------------
     def add_request(self, prompt, max_new_tokens: int = 16,
@@ -483,8 +623,10 @@ class LLMEngine:
             raise ValueError(f"prompt + max_new_tokens = {total} exceeds "
                              f"max_model_len {self.max_model_len}")
         rid = next(self._ids)
-        self._queue.append(Request(prompt, max_new_tokens, rid,
-                                   time.perf_counter(), temperature))
+        t = self._now()
+        self._queue.append(Request(prompt, max_new_tokens, rid, t,
+                                   temperature))
+        self._lifecycles[rid] = RequestMetrics(t_enqueue=t)
         return rid
 
     def _req_greedy(self, req: Request) -> bool:
@@ -527,8 +669,28 @@ class LLMEngine:
 
     def _finish_output(self, req: Request, token_ids: List[int], reason: str,
                        cached: int, ttft: Optional[float]) -> RequestOutput:
+        """Close the request's lifecycle record and publish the output.
+        Latency histograms only see stop/length retirements — an abort's
+        wall time measures the client, not the engine — but the abort still
+        gets its full RequestMetrics record and its own counter."""
+        lc = self._lifecycles.pop(req.request_id, None)
+        if lc is not None:
+            lc.t_finish = self._now()
+            lc.e2e_s = lc.t_finish - lc.t_enqueue
+            lc.cached_tokens = cached
+            lc.n_generated = len(token_ids)
+            if lc.t_first_token is not None and len(token_ids) > 1:
+                lc.tpot_s = (lc.t_finish - lc.t_first_token) / \
+                    (len(token_ids) - 1)
+            if reason == "abort":
+                self._aborted_requests.inc()
+            else:
+                self._finished_requests.inc()
+                self._h_e2e.observe(lc.e2e_s)
+                if lc.tpot_s is not None:
+                    self._h_tpot.observe(lc.tpot_s)
         out = RequestOutput(req.request_id, req.prompt, token_ids, reason,
-                            cached, ttft)
+                            cached, ttft, lc)
         self._outputs[out.request_id] = out
         return out
 
@@ -538,18 +700,64 @@ class LLMEngine:
                 return b
         raise ValueError(f"no bucket for prompt length {n}")
 
+    def _span(self, name: str):
+        """A profiler span for one host phase — real only while a trace is
+        recording (engine.trace() or a user Profiler); the steady-state step
+        loop pays a flag check, nothing else."""
+        if self._tracing or _prof.is_recording():
+            return _prof.RecordEvent(name)
+        return _NULL_SPAN
+
     # ---- scheduler --------------------------------------------------------
     def step(self) -> List[RequestOutput]:
         """One engine iteration: admit queued requests into free slots
         (prefix-cache matching + page reservation), advance at most ONE
         prefill chunk, then one decode step over every fully-prefilled slot.
-        Returns the requests that finished this iteration."""
+        Returns the requests that finished this iteration.
+
+        Each iteration appends one record to the step-trace ring
+        (`step_trace()`): what the step dispatched (decode-batch occupancy,
+        chunk interleaved, verify dispatches, tokens emitted) and the page
+        pool it left behind — the timeline that answers "what was the engine
+        doing when this request was slow"."""
         finished: List[RequestOutput] = []
-        self._admit(finished)
-        self._prefill_tick(finished)
-        if self._running:
-            self._decode_iter(finished)
+        t0 = self._now()
+        tok0 = self._decode_tokens.value
+        ver0 = self._verify_steps.value
+        chunk0 = self._prefill_chunks.value
+        with self._span("engine.step"):
+            with self._span("engine.admit"):
+                self._admit(finished)
+            self._prefill_tick(finished)
+            decode_batch = len(self._running)
+            if self._running:
+                self._decode_iter(finished)
+        dur = self._now() - t0
+        self._h_step.observe(dur)
+        self._step_idx += 1
+        mgr = self.cache
+        self._step_trace.append({
+            "step": self._step_idx,
+            "t": t0,
+            "dur_s": dur,
+            "queued": len(self._queue),
+            "prefilling": len(self._prefilling),
+            "running": len(self._running),
+            "decode_batch": decode_batch,
+            "chunk": self._prefill_chunks.value > chunk0,
+            "verify_dispatches": self._verify_steps.value - ver0,
+            "tokens_emitted": self._decode_tokens.value - tok0,
+            "finished": len(finished),
+            "pages_in_use": mgr.pages_in_use(),
+            "pages_free": mgr.num_free_pages,
+            "pages_evictable": mgr.num_evictable_pages,
+        })
         return finished
+
+    def step_trace(self) -> List[Dict[str, object]]:
+        """The per-step timeline ring, oldest first (bounded at `trace_ring`
+        records; cleared by `reset_counters()`)."""
+        return list(self._step_trace)
 
     def _admit(self, finished: List[RequestOutput]) -> None:
         mgr = self.cache
@@ -575,6 +783,12 @@ class LLMEngine:
                 break                       # wait for pages to free up
             self._queue.popleft()
             self._free_slots.pop()
+            lc = self._lifecycles.get(req.request_id)
+            if lc is not None:
+                lc.t_admit = self._now()
+                lc.queue_s = lc.t_admit - lc.t_enqueue
+                self._h_queue.observe(lc.queue_s)
+                lc.cached_tokens = matched
             if cow is not None:
                 # the matched partial page is shared: copy it into the slot's
                 # own page before anything is appended into it
@@ -582,11 +796,11 @@ class LLMEngine:
                 self._pool = self._copy_fn(self._pool,
                                            jnp.asarray(src, jnp.int32),
                                            jnp.asarray(dst, jnp.int32))
-                self._cow_copies += 1
+                self._cow_copies.inc()
                 self._copy_used = True
             if matched:
-                self._prefix_cached_tokens += matched
-                self._prefix_hit_requests += 1
+                self._prefix_cached_tokens.inc(matched)
+                self._prefix_hit_requests.inc()
             lp = req.prompt.size
             if not self.chunked and matched == 0:
                 # legacy one-shot bucketed prefill, synchronous at admission
@@ -594,12 +808,13 @@ class LLMEngine:
                 ids = np.zeros((1, bucket), np.int32)
                 ids[0, :lp] = req.prompt
                 pages = row[:bucket // mgr.page_size][None, :]
-                first, self._pool, self._key = self._prefill_fn(
-                    self.params, jnp.asarray(ids), self._pool,
-                    jnp.asarray(pages), jnp.asarray([lp], jnp.int32),
-                    self._key, jnp.asarray([self._req_greedy(req)]))
+                with self._span("engine.prefill.dispatch"):
+                    first, self._pool, self._key = self._prefill_fn(
+                        self.params, jnp.asarray(ids), self._pool,
+                        jnp.asarray(pages), jnp.asarray([lp], jnp.int32),
+                        self._key, jnp.asarray([self._req_greedy(req)]))
                 self._seen_buckets.add(bucket)
-                self._prefilled_tokens += lp
+                self._prefilled_tokens.inc(lp)
                 if self.prefix_cache:
                     mgr.register_prefix(slot, req.prompt, lp)
                 self._start_decoding(req, slot, int(np.asarray(first)[0]), 0,
@@ -621,14 +836,16 @@ class LLMEngine:
         n = min(C, lp - st.filled)
         ids = np.zeros((1, C), np.int32)
         ids[0, :n] = st.request.prompt[st.filled:st.filled + n]
-        tok, self._pool, self._key = self._chunk_fn(
-            self.params, jnp.asarray(ids), self._pool,
-            jnp.asarray(mgr.page_table[slot][None, :]),
-            jnp.asarray([st.filled], jnp.int32), jnp.asarray([n], jnp.int32),
-            self._key, jnp.asarray([self._req_greedy(st.request)]))
+        with self._span("engine.prefill.dispatch"):
+            tok, self._pool, self._key = self._chunk_fn(
+                self.params, jnp.asarray(ids), self._pool,
+                jnp.asarray(mgr.page_table[slot][None, :]),
+                jnp.asarray([st.filled], jnp.int32),
+                jnp.asarray([n], jnp.int32),
+                self._key, jnp.asarray([self._req_greedy(st.request)]))
         self._chunk_used = True
-        self._prefill_chunks += 1
-        self._prefilled_tokens += n
+        self._prefill_chunks.inc()
+        self._prefilled_tokens.inc(n)
         st.filled += n
         if self.prefix_cache:
             mgr.register_prefix(slot, st.request.prompt, st.filled)
@@ -641,7 +858,13 @@ class LLMEngine:
                         cached: int, finished: List[RequestOutput]) -> None:
         """Prompt fully in pages + first token picked: join the decode set."""
         self.cache.lengths[slot] = req.prompt.size
-        ttft = time.perf_counter() - req.t_enqueue
+        now = self._now()
+        ttft = now - req.t_enqueue
+        lc = self._lifecycles.get(req.request_id)
+        if lc is not None:
+            lc.t_first_token = now
+            lc.ttft_s = ttft
+        self._h_ttft.observe(ttft)
         seq = _Running(req, slot, [first], cached, ttft,
                        self._req_greedy(req))
         if not self._maybe_finish(seq, finished):
@@ -653,8 +876,12 @@ class LLMEngine:
         (undrafted ones at valid=1 — plain decode through the same program)
         and sampled slots fall back to the vanilla decode executable in the
         same iteration; otherwise everything takes the vanilla path."""
-        self._decode_iters += 1
-        drafts = self._propose_drafts() if self.spec_len else {}
+        self._decode_iters.inc()
+        if self.spec_len:
+            with self._span("engine.spec.propose"):
+                drafts = self._propose_drafts()
+        else:
+            drafts = {}
         if drafts:
             self._verify_iter(drafts, finished)
             rest = [s for s, seq in self._running.items() if not seq.greedy]
@@ -726,47 +953,53 @@ class LLMEngine:
                 tokens[slot, 1:1 + d.size] = d
                 valid[slot] = 1 + d.size
             qoff[slot] = mgr.lengths[slot]
-        preds, self._pool = self._verify_fn(
-            self.params, jnp.asarray(tokens), self._pool, jnp.asarray(table),
-            jnp.asarray(qoff), jnp.asarray(valid))
-        preds = np.asarray(preds)
-        self._verify_steps += 1
-        for slot in active:
-            seq = self._running[slot]
-            d = drafts.get(slot)
-            nd = 0 if d is None else d.size
-            a = 0
-            while a < nd and int(d[a]) == int(preds[slot, a]):
-                a += 1          # greedy longest-prefix acceptance
-            emitted = [int(x) for x in d[:a]] if nd else []
-            emitted.append(int(preds[slot, a]))        # bonus token
-            room = seq.request.max_new_tokens - len(seq.generated)
-            emitted = emitted[:room]
-            if self.eos_token_id is not None and self.eos_token_id in emitted:
-                emitted = emitted[:emitted.index(self.eos_token_id) + 1]
-            mgr.lengths[slot] += len(emitted)          # rejected KV: stale
-            seq.generated.extend(emitted)
-            self._decode_tokens += len(emitted)
-            if nd:
-                self._spec_events += 1
-                self._spec_drafted += nd
-                self._spec_accepted += a
-                self._spec_emitted += len(emitted)
-                # adaptive spec back-off: a slot whose drafts are NEVER
-                # accepted (acceptance rate ~0 over the window) stops paying
-                # the proposer scan and the wasted candidate positions — it
-                # keeps riding the verify program at valid=1.  Output parity
-                # is untouched: greedy acceptance is lossless either way.
-                if a == 0:
-                    seq.spec_zero_streak += 1
-                    if self.spec_backoff_window and not seq.spec_off and \
-                            seq.spec_zero_streak >= self.spec_backoff_window:
-                        seq.spec_off = True
-                        self._spec_backoffs += 1
-                else:
-                    seq.spec_zero_streak = 0
-            if self._maybe_finish(seq, finished):
-                del self._running[slot]
+        with self._span("engine.verify.dispatch"):
+            preds, self._pool = self._verify_fn(
+                self.params, jnp.asarray(tokens), self._pool,
+                jnp.asarray(table), jnp.asarray(qoff), jnp.asarray(valid))
+        with self._span("engine.sample.sync"):
+            preds = np.asarray(preds)       # blocks on the device result
+        self._verify_steps.inc()
+        with self._span("engine.spec.accept"):
+            for slot in active:
+                seq = self._running[slot]
+                d = drafts.get(slot)
+                nd = 0 if d is None else d.size
+                a = 0
+                while a < nd and int(d[a]) == int(preds[slot, a]):
+                    a += 1          # greedy longest-prefix acceptance
+                emitted = [int(x) for x in d[:a]] if nd else []
+                emitted.append(int(preds[slot, a]))        # bonus token
+                room = seq.request.max_new_tokens - len(seq.generated)
+                emitted = emitted[:room]
+                if self.eos_token_id is not None and \
+                        self.eos_token_id in emitted:
+                    emitted = emitted[:emitted.index(self.eos_token_id) + 1]
+                mgr.lengths[slot] += len(emitted)          # rejected KV: stale
+                seq.generated.extend(emitted)
+                self._decode_tokens.inc(len(emitted))
+                if nd:
+                    self._spec_events.inc()
+                    self._spec_drafted.inc(nd)
+                    self._spec_accepted.inc(a)
+                    self._spec_emitted.inc(len(emitted))
+                    # adaptive spec back-off: a slot whose drafts are NEVER
+                    # accepted (acceptance rate ~0 over the window) stops
+                    # paying the proposer scan and the wasted candidate
+                    # positions — it keeps riding the verify program at
+                    # valid=1.  Output parity is untouched: greedy acceptance
+                    # is lossless either way.
+                    if a == 0:
+                        seq.spec_zero_streak += 1
+                        if self.spec_backoff_window and not seq.spec_off and \
+                                seq.spec_zero_streak >= \
+                                self.spec_backoff_window:
+                            seq.spec_off = True
+                            self._spec_backoffs.inc()
+                    else:
+                        seq.spec_zero_streak = 0
+                if self._maybe_finish(seq, finished):
+                    del self._running[slot]
 
     def _vanilla_decode_iter(self, slots: List[int],
                              finished: List[RequestOutput]) -> None:
@@ -790,12 +1023,14 @@ class LLMEngine:
             table = table.copy()
             for slot in masked:
                 table[slot, :] = 0
-        nxt, self._pool, self._key = self._decode_fn(
-            self.params, jnp.asarray(tokens), self._pool,
-            jnp.asarray(table), jnp.asarray(mgr.lengths), self._key,
-            jnp.asarray(greedy))
-        self._decode_tokens += len(active)
-        nxt = np.asarray(nxt)
+        with self._span("engine.decode.dispatch"):
+            nxt, self._pool, self._key = self._decode_fn(
+                self.params, jnp.asarray(tokens), self._pool,
+                jnp.asarray(table), jnp.asarray(mgr.lengths), self._key,
+                jnp.asarray(greedy))
+        self._decode_tokens.inc(len(active))
+        with self._span("engine.sample.sync"):
+            nxt = np.asarray(nxt)           # blocks on the device result
         for slot in slots:
             seq = self._running[slot]
             mgr.lengths[slot] += 1          # the token we just fed is cached
@@ -856,18 +1091,69 @@ class LLMEngine:
         return bool(self._queue or self._running or self._prefilling)
 
     # ---- observability ----------------------------------------------------
+    @contextlib.contextmanager
+    def trace(self, dir_name: str, device: bool = True):
+        """Capture a serving trace window into `dir_name`:
+
+        - ``host_trace.json`` — chrome-tracing export of the engine's host
+          phase spans (`ENGINE_SPANS`: admit, prefill/verify/decode dispatch,
+          proposer scan, acceptance, sample sync) recorded through
+          `paddle_tpu.profiler.RecordEvent`, so it opens in the same
+          ``chrome://tracing`` / Perfetto flow as the trainer's traces;
+        - ``step_timeline.json`` — the step-trace ring as captured at exit;
+        - ``metrics.json`` — a full `metrics.snapshot()` (plus the proposer's
+          drafting telemetry when available);
+        - ``device/`` — a `jax.profiler` trace (TensorBoard XPlane) when
+          `device=True` and the runtime supports capture; spans also forward
+          as TraceAnnotations so engine phases land in the device timeline.
+
+        Tracing is additive-only: no executable recompiles (the spans wrap
+        host code), and the spans themselves exist only inside this window.
+        When a user `Profiler` is ALREADY recording, this window rides it
+        instead of starting its own (a nested start would wipe the outer
+        profiler's event buffer and a nested stop would end its recording):
+        the outer recording continues untouched and ``host_trace.json``
+        snapshots everything collected so far, engine spans included.
+        """
+        os.makedirs(dir_name, exist_ok=True)
+        prof = None
+        if not _prof.is_recording():
+            prof = _prof.Profiler(timer_only=not device,
+                                  log_dir=os.path.join(dir_name, "device"))
+            prof.start()
+        self._tracing = True
+        try:
+            yield prof
+        finally:
+            self._tracing = False
+            if prof is not None:
+                prof.stop()     # the event buffer survives stop()
+            _prof.dump_chrome_trace(os.path.join(dir_name,
+                                                 "host_trace.json"))
+            with open(os.path.join(dir_name, "step_timeline.json"), "w") as f:
+                json.dump(self.step_trace(), f)
+            snap = self.metrics.snapshot()
+            snap["proposer"] = getattr(self.proposer, "stats", dict)()
+            with open(os.path.join(dir_name, "metrics.json"), "w") as f:
+                json.dump(snap, f)
+
     def stats(self) -> Dict[str, object]:
         def execs(fn, fallback):
+            # only the expected miss — a plain-jit wrapper without
+            # _cache_size — falls back to the tracked approximation; a real
+            # bug INSIDE _cache_size must raise, not be silently counted
             try:
                 return fn._cache_size()
-            except Exception:
+            except AttributeError:
                 return fallback
-        cached, computed = self._prefix_cached_tokens, self._prefilled_tokens
+        cached = self._prefix_cached_tokens.value
+        computed = self._prefilled_tokens.value
+        spec_events = self._spec_events.value
         return {
             "decode_executables": execs(self._decode_fn,
-                                        1 if self._decode_iters else 0),
+                                        1 if self._decode_iters.value else 0),
             "verify_executables": execs(self._verify_fn,
-                                        1 if self._verify_steps else 0),
+                                        1 if self._verify_steps.value else 0),
             "prefill_executables": execs(self._prefill_fn,
                                          len(self._seen_buckets)) +
                                    execs(self._chunk_fn,
@@ -878,24 +1164,28 @@ class LLMEngine:
             "prefill_chunk": self.prefill_chunk,
             "spec_len": self.spec_len,
             "mp": self.mp,
-            "decode_iterations": self._decode_iters,
-            "decode_tokens": self._decode_tokens,
-            "verify_steps": self._verify_steps,
-            "spec_drafted_tokens": self._spec_drafted,
-            "spec_accepted_tokens": self._spec_accepted,
-            "spec_emitted_tokens": self._spec_emitted,
-            "spec_backoffs": self._spec_backoffs,
+            "engine_steps": self._step_idx,
+            "decode_iterations": self._decode_iters.value,
+            "decode_tokens": self._decode_tokens.value,
+            "verify_steps": self._verify_steps.value,
+            # per-slot verify events that carried a draft — the denominator
+            # of accepted_per_step, reported so benches can recompute it
+            "spec_events": spec_events,
+            "spec_drafted_tokens": self._spec_drafted.value,
+            "spec_accepted_tokens": self._spec_accepted.value,
+            "spec_emitted_tokens": self._spec_emitted.value,
+            "spec_backoffs": self._spec_backoffs.value,
             # mean tokens emitted per drafted verify event (>= 1.0; 1.0 means
             # drafts never helped, spec_len+1 means every draft fully accepted)
-            "accepted_per_step": self._spec_emitted / self._spec_events
-                                 if self._spec_events else 0.0,
-            "prefill_chunks": self._prefill_chunks,
+            "accepted_per_step": self._spec_emitted.value / spec_events
+                                 if spec_events else 0.0,
+            "prefill_chunks": self._prefill_chunks.value,
             "prefilled_tokens": computed,
             "prefix_cached_tokens": cached,
-            "prefix_hit_requests": self._prefix_hit_requests,
+            "prefix_hit_requests": self._prefix_hit_requests.value,
             "prefix_hit_rate": cached / (cached + computed)
                                if cached + computed else 0.0,
-            "cow_page_copies": self._cow_copies,
+            "cow_page_copies": self._cow_copies.value,
             "pages_in_use": self.cache.pages_in_use(),
             "pages_free": self.cache.num_free_pages,
             "pages_evictable": self.cache.num_evictable_pages,
@@ -905,4 +1195,15 @@ class LLMEngine:
             "queued": len(self._queue),
             "prefilling": len(self._prefilling),
             "running": len(self._running),
+            "finished_requests": self._finished_requests.value,
+            "aborted_requests": self._aborted_requests.value,
+            # latency distributions (engine-side histograms; seconds) — the
+            # serving SLO surface: benches report p50/p99 straight from here
+            "latency": {
+                "queue_s": self._h_queue.summary(),
+                "ttft_s": self._h_ttft.summary(),
+                "tpot_s": self._h_tpot.summary(),
+                "e2e_s": self._h_e2e.summary(),
+                "step_s": self._h_step.summary(),
+            },
         }

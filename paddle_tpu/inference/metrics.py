@@ -1,0 +1,325 @@
+"""Serving metrics: Counter / Gauge / Histogram + a registry with JSON and
+Prometheus export.
+
+Reference lineage: the reference repo's profiler subsystem
+(`python/paddle/profiler` + `fluid/platform/profiler/`) covers *traces* —
+span trees and chrome-tracing export — but serving fleets are scraped, not
+traced: Orca (Yu et al., OSDI 2022) and vLLM (Kwon et al., SOSP 2023) treat
+request-lifecycle latency distributions and engine counters as first-class
+monitoring state.  This module is that layer for `inference.engine.LLMEngine`:
+
+- **Counter** — monotonic event count (tokens emitted, verify dispatches,
+  evictions).  `inc()` only; scrapers derive rates from successive scrapes.
+- **Gauge** — an instantaneous level, either `set()` explicitly or backed by
+  a zero-argument callback evaluated at snapshot time (pages in use, queue
+  depth) so the hot path never pushes gauge updates.
+- **Histogram** — fixed log-spaced buckets (latencies span decades: a queue
+  wait is 10 us under no load and 10 s under overload; linear buckets waste
+  resolution at one end).  The hot path is one `bisect` + three adds, pure
+  Python, no numpy allocation.  Percentiles interpolate linearly inside the
+  covering bucket (the Prometheus `histogram_quantile` convention); values
+  past the last edge report the observed maximum instead of an edge clamp.
+
+The registry owns the **clock** (`now()`), injectable so lifecycle tests can
+drive deterministic timestamps through the engine; the default is
+`time.perf_counter`, the same monotonic base the engine already stamps
+`Request.t_enqueue` with.
+
+Export surfaces:
+- `snapshot()` — plain-JSON dict `{counters, gauges, histograms}` (histograms
+  as `{count, sum, mean, min, max, p50, p90, p99}` summaries), embedded in
+  bench JSON and `engine.trace()` dumps;
+- `to_prometheus()` — text exposition format (`# HELP` / `# TYPE` + samples,
+  cumulative `_bucket{le=...}` rows ending at `+Inf`, `_sum`/`_count`), ready
+  for a scrape endpoint.  `tools/check_metrics.py` parses this output in CI.
+"""
+from __future__ import annotations
+
+import math
+import re
+import time
+from bisect import bisect_left
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Sequence
+
+
+def log_buckets(lo: float, hi: float, per_decade: int = 4) -> List[float]:
+    """Geometric bucket edges covering [lo, hi]: `per_decade` edges per 10x,
+    computed as lo * r**i (no compounding float drift), last edge >= hi."""
+    if not (lo > 0.0 and hi > lo):
+        raise ValueError(f"need 0 < lo < hi, got [{lo}, {hi}]")
+    if per_decade < 1:
+        raise ValueError(f"per_decade must be >= 1, got {per_decade}")
+    ratio = 10.0 ** (1.0 / per_decade)
+    n = math.ceil(per_decade * math.log10(hi / lo))
+    edges = [lo * ratio ** i for i in range(n + 1)]
+    if edges[-1] < hi:          # guard log10 rounding just under hi
+        edges.append(edges[-1] * ratio)
+    return edges
+
+
+# 100 us .. 100 s, 4 edges per decade (25 buckets + overflow): spans a CPU
+# smoke TTFT (~ms) and an overloaded queue wait (~10 s) at ~78% edge ratio
+DEFAULT_LATENCY_BUCKETS = log_buckets(1e-4, 100.0, 4)
+
+
+class Counter:
+    """Monotonic counter.  `.value` for host reads; resets only via the
+    registry (bench warmup exclusion), never decrements in between."""
+
+    __slots__ = ("name", "help", "_value")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot decrease (inc {n})")
+        self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+    def __bool__(self) -> bool:
+        return self._value != 0
+
+    def reset(self) -> None:
+        self._value = 0
+
+
+class Gauge:
+    """Instantaneous level: `set()` pushed, or `fn` pulled at read time (the
+    engine registers pull gauges over cache/queue state so the scheduler hot
+    path never updates them)."""
+
+    __slots__ = ("name", "help", "_value", "_fn")
+
+    def __init__(self, name: str, fn: Optional[Callable[[], float]] = None,
+                 help: str = ""):
+        self.name = name
+        self.help = help
+        self._fn = fn
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        if self._fn is not None:
+            raise ValueError(f"gauge {self.name} is callback-backed")
+        self._value = v
+
+    @property
+    def value(self) -> float:
+        return float(self._fn() if self._fn is not None else self._value)
+
+    def reset(self) -> None:
+        if self._fn is None:
+            self._value = 0.0
+
+
+class Histogram:
+    """Fixed-bucket histogram with le-semantics edges (`counts[i]` holds
+    observations in `(edges[i-1], edges[i]]`; larger values land in the
+    overflow bucket).  Tracks count/sum/min/max exactly; percentiles are
+    bucket-interpolated estimates."""
+
+    __slots__ = ("name", "help", "edges", "counts", "overflow",
+                 "count", "sum", "_min", "_max")
+
+    def __init__(self, name: str, buckets: Optional[Sequence[float]] = None,
+                 help: str = ""):
+        self.name = name
+        self.help = help
+        edges = [float(e) for e in (buckets if buckets is not None
+                                    else DEFAULT_LATENCY_BUCKETS)]
+        if not edges or any(b <= a for a, b in zip(edges, edges[1:])):
+            raise ValueError(f"bucket edges must be strictly increasing "
+                             f"and non-empty, got {edges}")
+        self.edges = edges
+        self.reset()
+
+    def reset(self) -> None:
+        self.counts = [0] * len(self.edges)
+        self.overflow = 0
+        self.count = 0
+        self.sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        i = bisect_left(self.edges, v)      # first edge >= v: the le bucket
+        if i < len(self.edges):
+            self.counts[i] += 1
+        else:
+            self.overflow += 1
+        self.count += 1
+        self.sum += v
+        if v < self._min:
+            self._min = v
+        if v > self._max:
+            self._max = v
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+    @property
+    def min(self) -> float:
+        return self._min if self.count else 0.0
+
+    @property
+    def max(self) -> float:
+        return self._max if self.count else 0.0
+
+    def percentile(self, p: float) -> float:
+        """Estimate the p-th percentile (0..100): linear interpolation inside
+        the bucket where the cumulative count crosses rank p/100 * count
+        (lower edge of the first bucket taken as 0), clamped to the observed
+        [min, max] envelope so a sparse bucket cannot report a quantile
+        outside the data.  Ranks landing in the overflow bucket return the
+        exact observed maximum."""
+        if not 0.0 <= p <= 100.0:
+            raise ValueError(f"percentile must be in [0, 100], got {p}")
+        if self.count == 0:
+            return 0.0
+        rank = p / 100.0 * self.count
+        if rank <= 0.0:
+            return self.min
+        cum = 0
+        prev = 0.0
+        for edge, c in zip(self.edges, self.counts):
+            cum += c
+            if c and cum >= rank:
+                v = prev + (edge - prev) * (rank - (cum - c)) / c
+                return min(max(v, self._min), self._max)
+            prev = edge
+        return self.max                     # rank falls in the overflow bucket
+
+    def summary(self) -> Dict[str, float]:
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "mean": self.mean,
+            "min": self.min,
+            "max": self.max,
+            "p50": self.percentile(50.0),
+            "p90": self.percentile(90.0),
+            "p99": self.percentile(99.0),
+        }
+
+
+def _sanitize(name: str) -> str:
+    """Prometheus metric-name charset: [a-zA-Z_:][a-zA-Z0-9_:]*."""
+    name = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    return "_" + name if name and name[0].isdigit() else name
+
+
+def _fmt(v: float) -> str:
+    if isinstance(v, int):
+        return str(v)
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    return f"{v:.10g}"
+
+
+class MetricsRegistry:
+    """Namespace of metrics sharing one injectable monotonic clock.
+
+    Factory methods are idempotent per name (the same Counter comes back, so
+    the engine and the cache manager can both ask for `prefix_evictions`);
+    asking for an existing name as a different type raises."""
+
+    def __init__(self, namespace: str = "",
+                 clock: Callable[[], float] = time.perf_counter):
+        self.namespace = namespace
+        self._clock = clock
+        self._metrics: "OrderedDict[str, object]" = OrderedDict()
+
+    def now(self) -> float:
+        """The registry clock — every lifecycle stamp the engine takes goes
+        through here, so tests inject a fake and get exact latencies."""
+        return self._clock()
+
+    def _register(self, name: str, cls, factory):
+        m = self._metrics.get(name)
+        if m is not None:
+            if not isinstance(m, cls):
+                raise TypeError(f"metric {name!r} already registered as "
+                                f"{type(m).__name__}, not {cls.__name__}")
+            return m
+        m = factory()
+        self._metrics[name] = m
+        return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._register(name, Counter, lambda: Counter(name, help))
+
+    def gauge(self, name: str, fn: Optional[Callable[[], float]] = None,
+              help: str = "") -> Gauge:
+        return self._register(name, Gauge, lambda: Gauge(name, fn, help))
+
+    def histogram(self, name: str,
+                  buckets: Optional[Sequence[float]] = None,
+                  help: str = "") -> Histogram:
+        return self._register(name, Histogram,
+                              lambda: Histogram(name, buckets, help))
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def reset(self) -> None:
+        """Zero counters and histograms (set-gauges too; callback gauges read
+        live state and have nothing to reset) — the engine's
+        `reset_counters()` warmup-exclusion hook."""
+        for m in self._metrics.values():
+            m.reset()
+
+    def snapshot(self) -> Dict[str, Dict[str, object]]:
+        """Plain-JSON view: counters/gauges as scalars, histograms as
+        summary dicts.  Callback gauges are evaluated here, once."""
+        out: Dict[str, Dict[str, object]] = {"counters": {}, "gauges": {},
+                                             "histograms": {}}
+        for name, m in self._metrics.items():
+            if isinstance(m, Counter):
+                out["counters"][name] = m.value
+            elif isinstance(m, Gauge):
+                out["gauges"][name] = m.value
+            else:
+                out["histograms"][name] = m.summary()
+        return out
+
+    def to_prometheus(self) -> str:
+        """Text exposition format, one block per metric: HELP/TYPE comments,
+        `_total` suffix on counters, cumulative `_bucket` rows ending at
+        `+Inf` plus `_sum`/`_count` on histograms."""
+        ns = _sanitize(self.namespace + "_") if self.namespace else ""
+        lines: List[str] = []
+        for name, m in self._metrics.items():
+            full = ns + _sanitize(name)
+            if isinstance(m, Counter):
+                tname = full if full.endswith("_total") else full + "_total"
+                if m.help:
+                    lines.append(f"# HELP {tname} {m.help}")
+                lines.append(f"# TYPE {tname} counter")
+                lines.append(f"{tname} {m.value}")
+            elif isinstance(m, Gauge):
+                if m.help:
+                    lines.append(f"# HELP {full} {m.help}")
+                lines.append(f"# TYPE {full} gauge")
+                lines.append(f"{full} {_fmt(m.value)}")
+            else:
+                if m.help:
+                    lines.append(f"# HELP {full} {m.help}")
+                lines.append(f"# TYPE {full} histogram")
+                cum = 0
+                for edge, c in zip(m.edges, m.counts):
+                    cum += c
+                    lines.append(f'{full}_bucket{{le="{_fmt(edge)}"}} {cum}')
+                lines.append(f'{full}_bucket{{le="+Inf"}} {m.count}')
+                lines.append(f"{full}_sum {_fmt(m.sum)}")
+                lines.append(f"{full}_count {m.count}")
+        return "\n".join(lines) + "\n"

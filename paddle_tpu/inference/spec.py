@@ -23,7 +23,7 @@ matching kernel numerics; see the engine docstring for the TPU bf16 caveat).
 """
 from __future__ import annotations
 
-from typing import Optional
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -47,6 +47,35 @@ class DraftProposer:
         Returns int32 [n] with 1 <= n <= max_tokens, or None for no draft
         (the slot falls back to vanilla decode this iteration)."""
         raise NotImplementedError
+
+    # Observability: drafting runs on the host inside every decode iteration,
+    # so the engine's step trace wants the proposer's own view of its traffic
+    # (how often the scan even finds a match is a victim-selection signal the
+    # slot-level acceptance counters cannot recover).  Both hooks are
+    # optional — the engine probes with getattr and tolerates proposers that
+    # track nothing.
+    def stats(self) -> Dict[str, object]:
+        """Host-side drafting telemetry; default: nothing tracked."""
+        return {}
+
+    def reset_stats(self) -> None:
+        """Zero the telemetry (the engine's `reset_counters()` warmup hook);
+        default: nothing to zero."""
+
+
+class _NgramStats:
+    """Plain-int telemetry for NgramProposer — kept off the DraftProposer
+    hot-path contract so a stats-less custom proposer costs nothing."""
+
+    __slots__ = ("calls", "hits", "tokens_proposed")
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.calls = 0
+        self.hits = 0
+        self.tokens_proposed = 0
 
 
 class NgramProposer(DraftProposer):
@@ -75,11 +104,25 @@ class NgramProposer(DraftProposer):
         # bounded scan (see DraftProposer.max_lookback): recent history is
         # also where loop/structure matches live
         self.max_lookback = max_lookback
+        self._stats = _NgramStats()
+
+    def stats(self) -> Dict[str, object]:
+        s = self._stats
+        return {
+            "propose_calls": s.calls,
+            "propose_hits": s.hits,
+            "tokens_proposed": s.tokens_proposed,
+            "hit_rate": s.hits / s.calls if s.calls else 0.0,
+        }
+
+    def reset_stats(self) -> None:
+        self._stats.reset()
 
     def propose(self, context: np.ndarray,
                 max_tokens: int) -> Optional[np.ndarray]:
         # the engine already hands over only the window; re-slice so direct
         # callers (tests, other schedulers) get the same bounded contract
+        self._stats.calls += 1
         ctx = np.asarray(context).reshape(-1)[-self.max_lookback:]
         L = ctx.size
         if max_tokens < 1 or L < self.min_ngram + 1:
@@ -99,5 +142,7 @@ class NgramProposer(DraftProposer):
                 j = int(full[-1]) if full.size else int(hits[0])
                 prop = ctx[j + n:j + n + max_tokens]
                 if prop.size:
+                    self._stats.hits += 1
+                    self._stats.tokens_proposed += prop.size
                     return prop.astype(np.int32, copy=True)
         return None

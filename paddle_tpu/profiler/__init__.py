@@ -1,4 +1,4 @@
 from .profiler import (Profiler, ProfilerState, ProfilerTarget, RecordEvent,  # noqa
-                       SortedKeys, export_chrome_tracing, load_profiler_result,
-                       make_scheduler)
+                       SortedKeys, dump_chrome_trace, export_chrome_tracing,
+                       is_recording, load_profiler_result, make_scheduler)
 from .timer import Benchmark, benchmark  # noqa

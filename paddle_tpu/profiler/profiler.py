@@ -13,6 +13,7 @@ import json
 import os
 import threading
 import time
+from collections import deque
 from enum import Enum
 from typing import Callable, Iterable, Optional
 
@@ -53,8 +54,34 @@ class _HostEvent:
         self.tid = tid
 
 
-_events = []
+# Host-span buffer cap: recording is a ring over the newest spans, like the
+# serving engine's step-trace ring — a trace window left open over a soak run
+# must not grow host memory without bound (~10 engine spans per serving step).
+HOST_EVENT_CAP = 1_000_000
+
+_events = deque(maxlen=HOST_EVENT_CAP)
 _recording = False
+_TRACE_ANNOTATION = None        # cached jax.profiler.TraceAnnotation lookup
+
+
+def is_recording() -> bool:
+    """Whether a Profiler is currently collecting host spans — callers with
+    spans on a hot path (the serving engine's per-step phases) gate span
+    construction on this instead of paying RecordEvent setup every step."""
+    return _recording
+
+
+def _trace_annotation():
+    # resolve jax.profiler.TraceAnnotation once per process; False caches a
+    # failed import so a jax-less environment doesn't retry on every span
+    global _TRACE_ANNOTATION
+    if _TRACE_ANNOTATION is None:
+        try:
+            import jax.profiler
+            _TRACE_ANNOTATION = jax.profiler.TraceAnnotation
+        except Exception:
+            _TRACE_ANNOTATION = False
+    return _TRACE_ANNOTATION
 
 
 class RecordEvent:
@@ -68,12 +95,13 @@ class RecordEvent:
 
     def begin(self):
         self._t0 = time.perf_counter_ns()
-        try:
-            import jax.profiler
-            self._scope = jax.profiler.TraceAnnotation(self.name)
-            self._scope.__enter__()
-        except Exception:
-            self._scope = None
+        cls = _trace_annotation()
+        if cls:
+            try:
+                self._scope = cls(self.name)
+                self._scope.__enter__()
+            except Exception:
+                self._scope = None
 
     def end(self):
         if self._scope is not None:
@@ -111,6 +139,18 @@ def make_scheduler(closed: int, ready: int, record: int, repeat: int = 0,
     return scheduler
 
 
+def dump_chrome_trace(fname: str) -> None:
+    """Serialize the host spans recorded so far (the module event buffer) as
+    chrome-tracing JSON — usable mid-recording, so a capture window nested
+    inside a longer-running Profiler can snapshot without stopping it."""
+    traceEvents = [{
+        "name": e.name, "ph": "X", "ts": e.start / 1000.0,
+        "dur": (e.end - e.start) / 1000.0, "pid": 0, "tid": e.tid,
+    } for e in _events]
+    with open(fname, "w") as f:
+        json.dump({"traceEvents": traceEvents}, f)
+
+
 def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
     def handler(prof):
         os.makedirs(dir_name, exist_ok=True)
@@ -129,24 +169,25 @@ class Profiler:
     def __init__(self, targets: Optional[Iterable] = None, scheduler=None,
                  on_trace_ready=None, record_shapes=False, profile_memory=False,
                  timer_only=False, emit_nvtx=False, custom_device_types=None,
-                 with_flops=False):
+                 with_flops=False, log_dir="profiler_log"):
         self._scheduler = scheduler if callable(scheduler) else (
             make_scheduler(*scheduler) if scheduler else (lambda step: ProfilerState.RECORD))
         self._on_trace_ready = on_trace_ready
         self._step = 0
         self._timer_only = timer_only
+        self._log_dir = log_dir
         self._jax_dir = None
         self._state = ProfilerState.CLOSED
 
     def start(self):
         global _recording, _events
-        _events = []
+        _events = deque(maxlen=HOST_EVENT_CAP)
         _recording = True
         self._state = self._scheduler(self._step)
         if not self._timer_only:
             try:
                 import jax.profiler
-                self._jax_dir = os.path.join("profiler_log", f"jaxtrace_{int(time.time())}")
+                self._jax_dir = os.path.join(self._log_dir, f"jaxtrace_{int(time.time())}")
                 jax.profiler.start_trace(self._jax_dir)
             except Exception:
                 self._jax_dir = None
@@ -180,12 +221,7 @@ class Profiler:
         return False
 
     def _export_chrome(self, fname):
-        traceEvents = [{
-            "name": e.name, "ph": "X", "ts": e.start / 1000.0,
-            "dur": (e.end - e.start) / 1000.0, "pid": 0, "tid": e.tid,
-        } for e in _events]
-        with open(fname, "w") as f:
-            json.dump({"traceEvents": traceEvents}, f)
+        dump_chrome_trace(fname)
 
     def export(self, path, format="json"):
         self._export_chrome(path)

@@ -1,0 +1,215 @@
+#!/usr/bin/env python
+"""CI guard: the serving observability schema.
+
+The metrics surface is an API: dashboards scrape `to_prometheus()`, benches
+read `stats()`, and the ROADMAP's preemption/router work will consume the
+page gauges and step timeline.  This script re-measures the contract on every
+run so a future PR cannot silently drop a key, break the exposition format,
+or make a "counter" go backwards:
+
+- **stats() schema** — every key in REQUIRED_STATS_KEYS present (the frozen
+  serving-stats surface, including the latency histogram block);
+- **registry schema** — required counters/gauges/histograms present in
+  `metrics.snapshot()`;
+- **exposition** — `to_prometheus()` parses line-by-line against the
+  Prometheus text format: HELP/TYPE comments only, well-formed samples,
+  `_bucket` series cumulative and ending at `+Inf` == `_count`;
+- **monotonicity** — across a CPU-smoke engine loop that exercises admission,
+  chunked prefill, speculative verify, prefix hits, LRU eviction AND abort,
+  no counter ever decreases between steps;
+- **program budget** — decode-side compiled programs <= 2 with metrics
+  enabled (observability is host-only; see tools/check_program_count.py for
+  the full per-mesh budget).
+
+Exits non-zero with a diff on violation.  Usage:
+    JAX_PLATFORMS=cpu python tools/check_metrics.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REQUIRED_STATS_KEYS = frozenset({
+    "decode_executables", "verify_executables", "prefill_executables",
+    "copy_executables", "buckets", "prefill_chunk", "spec_len", "mp",
+    "engine_steps", "decode_iterations", "decode_tokens", "verify_steps",
+    "spec_events", "spec_drafted_tokens", "spec_accepted_tokens",
+    "spec_emitted_tokens", "spec_backoffs", "accepted_per_step",
+    "prefill_chunks", "prefilled_tokens", "prefix_cached_tokens",
+    "prefix_hit_requests", "prefix_hit_rate", "cow_page_copies",
+    "pages_in_use", "pages_free", "pages_evictable", "prefix_evictions",
+    "kv_token_capacity", "dense_token_footprint", "queued", "prefilling",
+    "running", "finished_requests", "aborted_requests", "latency",
+})
+REQUIRED_LATENCY_KEYS = frozenset(
+    {"queue_s", "ttft_s", "tpot_s", "e2e_s", "step_s"})
+REQUIRED_COUNTERS = frozenset({
+    "decode_iterations", "decode_tokens", "prefill_chunks",
+    "prefilled_tokens", "prefix_cached_tokens", "prefix_hit_requests",
+    "cow_page_copies", "verify_steps", "spec_events", "spec_drafted_tokens",
+    "spec_accepted_tokens", "spec_emitted_tokens", "spec_backoffs",
+    "finished_requests", "aborted_requests", "prefix_evictions",
+})
+REQUIRED_GAUGES = frozenset({
+    "queued", "prefilling", "running", "kv_pages_in_use", "kv_pages_free",
+    "kv_pages_evictable", "prefix_cached_pages",
+})
+REQUIRED_HISTOGRAMS = frozenset({
+    "queue_time_seconds", "ttft_seconds", "tpot_seconds",
+    "e2e_latency_seconds", "step_seconds",
+})
+
+_SAMPLE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"              # metric name
+    r'(\{le="[^"]+"\})?'                        # optional le label (hist)
+    r" (-?(?:[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?|Inf|NaN)|\+Inf)$")
+_COMMENT = re.compile(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+$")
+
+
+def parse_prometheus(text):
+    """Minimal exposition-format checker: returns {name: [(labels, value)]},
+    raising ValueError on any malformed line."""
+    samples = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            if not _COMMENT.match(line):
+                raise ValueError(f"malformed comment line: {line!r}")
+            continue
+        m = _SAMPLE.match(line)
+        if m is None:
+            raise ValueError(f"malformed sample line: {line!r}")
+        name, labels, value = m.group(1), m.group(2) or "", m.group(3)
+        samples.setdefault(name, []).append((labels, float(value)))
+    return samples
+
+
+def check_exposition(text, errors):
+    try:
+        samples = parse_prometheus(text)
+    except ValueError as e:
+        errors.append(str(e))
+        return
+    for base in (n[:-len("_bucket")] for n in samples if n.endswith("_bucket")):
+        buckets = samples[base + "_bucket"]
+        counts = [v for _, v in buckets]
+        if counts != sorted(counts):
+            errors.append(f"{base}_bucket series is not cumulative: {counts}")
+        if buckets[-1][0] != '{le="+Inf"}':
+            errors.append(f"{base}_bucket does not end at le=+Inf")
+        count = samples.get(base + "_count")
+        if count is None:
+            errors.append(f"{base}_count sample missing")
+        elif count[0][1] != counts[-1]:
+            errors.append(f"{base}: +Inf bucket {counts[-1]} != "
+                          f"_count {count[0][1]}")
+        if base + "_sum" not in samples:
+            errors.append(f"{base}_sum sample missing")
+
+
+def run_smoke(errors):
+    """Drive every scheduler lane on a tiny engine, asserting per-step that
+    no counter decreases; returns the final stats()/snapshot pair."""
+    import jax
+    import numpy as np
+
+    from paddle_tpu.inference.engine import LLMEngine
+    from paddle_tpu.models import gpt as G
+
+    cfg = G.gpt_tiny(64)
+    params = G.init_params(cfg, jax.random.key(0))
+    # 8-page pool under 2 slots: retiring requests park prefixes in the LRU
+    # and later distinct prompts evict them (the eviction counter must move)
+    eng = LLMEngine(params, cfg, num_slots=2, page_size=8, num_pages=9,
+                    max_model_len=64, prefill_chunk=16, spec_len=3, seed=11)
+    rng = np.random.RandomState(11)
+    shared = rng.randint(0, cfg.vocab_size, (20,)).astype(np.int32)
+    rids = []
+    for i in range(10):
+        if i % 3 == 0:      # shared-prefix family: prefix hits + COW
+            tail = rng.randint(0, cfg.vocab_size, (i,)).astype(np.int32)
+            prompt = np.concatenate([shared, tail]) if i else shared.copy()
+        else:               # distinct prompts: forces LRU eviction churn
+            prompt = rng.randint(0, cfg.vocab_size,
+                                 (int(rng.randint(4, 40)),)).astype(np.int32)
+        rids.append(eng.add_request(prompt, max_new_tokens=6))
+    prev = eng.metrics.snapshot()["counters"]
+    aborted = False
+    steps = 0
+    while eng.has_work:
+        eng.step()
+        steps += 1
+        if steps == 4 and not aborted:      # mid-flight abort lane
+            aborted = eng.abort(rids[-1])
+        cur = eng.metrics.snapshot()["counters"]
+        for k, v in cur.items():
+            if v < prev.get(k, 0):
+                errors.append(f"counter {k} decreased: "
+                              f"{prev[k]} -> {v} at step {steps}")
+        prev = cur
+    if not aborted:
+        errors.append("abort lane never exercised")
+    st = eng.stats()
+    if st["prefix_evictions"] < 1:
+        errors.append("eviction lane never exercised "
+                      f"(prefix_evictions={st['prefix_evictions']})")
+    if st["spec_events"] < 1:
+        errors.append("speculative lane never exercised (spec_events=0)")
+    if st["prefix_hit_requests"] < 1:
+        errors.append("prefix-hit lane never exercised")
+    return eng, st
+
+
+def main() -> int:
+    errors = []
+    eng, st = run_smoke(errors)
+
+    missing = REQUIRED_STATS_KEYS - set(st)
+    if missing:
+        errors.append(f"stats() missing keys: {sorted(missing)}")
+    if not missing:
+        lat_missing = REQUIRED_LATENCY_KEYS - set(st["latency"])
+        if lat_missing:
+            errors.append(f"stats()['latency'] missing: {sorted(lat_missing)}")
+
+    snap = eng.metrics.snapshot()
+    for section, required in (("counters", REQUIRED_COUNTERS),
+                              ("gauges", REQUIRED_GAUGES),
+                              ("histograms", REQUIRED_HISTOGRAMS)):
+        miss = required - set(snap.get(section, {}))
+        if miss:
+            errors.append(f"snapshot()[{section!r}] missing: {sorted(miss)}")
+    try:
+        json.dumps(snap)
+    except TypeError as e:
+        errors.append(f"snapshot() is not JSON-serializable: {e}")
+
+    check_exposition(eng.metrics.to_prometheus(), errors)
+
+    # observability must be free of compiled programs: decode-side budget
+    # unchanged (the full per-mesh budget lives in check_program_count.py)
+    decode_side = st["decode_executables"] + st["verify_executables"]
+    if decode_side > 2:
+        errors.append(f"decode-side executables {decode_side} > 2 with "
+                      f"metrics enabled — instrumentation leaked into a "
+                      f"compiled program")
+
+    report = {"metric": "serve_metrics_schema", "ok": not errors,
+              "decode_side_executables": decode_side,
+              "prefix_evictions": st["prefix_evictions"],
+              "spec_events": st["spec_events"],
+              "aborted_requests": st["aborted_requests"],
+              "errors": errors}
+    for e in errors:
+        print(f"FAIL: {e}", file=sys.stderr)
+    print(json.dumps(report))
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
