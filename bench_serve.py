@@ -160,7 +160,12 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
     # timelines, wrap a short window in `engine.trace(dir)` directly
     trace_ctx = eng.trace(trace_dir, device=False) if trace_dir \
         else contextlib.nullcontext()
-    with trace_ctx:
+    # the WARMED section runs under jax.transfer_guard("disallow"): every
+    # executable is compiled, so any implicit host<->device transfer left in
+    # the steady-state loop (a stray scalar h2d, an unplanned reshard under
+    # mp) is a bug, and this is where it would silently tax every step — the
+    # runtime twin of tpu_lint's TPL001/TPL005 static checks
+    with trace_ctx, jax.transfer_guard("disallow"):
         # clock starts AFTER trace-context entry (mkdir + profiler start) and
         # stops BEFORE its exit (trace serialization): capture setup/teardown
         # must not count against the traced pass's tokens/s

@@ -62,13 +62,20 @@ class GradScaler:
                 "unscale_() has already been called on this optimizer since the "
                 "last step()")
         inv = 1.0 / self._scale
-        found = False
+        # accumulate the inf/nan flag ON DEVICE across the parameter loop and
+        # sync once at the end — bool() per parameter serialized the step on
+        # one scalar round-trip per tensor (tpu_lint TPL001)
+        found_dev = None
         for p in optimizer._parameter_list or []:
             if p.grad is None:
                 continue
             g = p.grad._data.astype(jnp.float32) * inv
-            found = found or bool(jnp.any(~jnp.isfinite(g)))
+            bad = jnp.any(~jnp.isfinite(g))
+            found_dev = bad if found_dev is None else (found_dev | bad)
             p.grad._data = g.astype(p.grad._data.dtype)
+        # the skip/keep decision is a host branch, so one sync is the contract
+        # tpu-lint: disable=TPL001 -- single scalar sync per unscale_ by design
+        found = bool(found_dev) if found_dev is not None else False
         state["unscaled"] = True
         state["found_inf"] = found
         # update() adjusts the scale off the union of inf sightings this round

@@ -1,0 +1,34 @@
+"""paddle_tpu.analysis — static analysis that proves the engine's
+dispatch/sync discipline (reference counterpart: the `tools/` CI-check layer
+of custom op-registry/API/lint guards; SURVEY §tools).
+
+Two levels, one CLI (`tools/tpu_lint.py`):
+
+- **Level 1 (AST, stdlib-only)** — `visitor.py` + `rules.py`: host-sync
+  taint in step()-reachable code, unregistered jit/shard_map sites (checked
+  against `registry.py`, the declared program source-of-truth), missing
+  donation, Python branches on traced values, untimed device fetches, broad
+  excepts around device code.  Per-rule inline suppressions with mandatory
+  reasons.
+- **Level 2 (jaxpr)** — `jaxpr_checks.py`: traces the registry-declared
+  serving executables with abstract inputs and audits the closed jaxprs for
+  transfer primitives, donation mismatches, dtype upcasts and (mp) missing
+  sharding constraints.
+"""
+from __future__ import annotations
+
+from .rules import (AST_RULES, Finding, Rule, Suppressions, rule_table)
+from .visitor import (FileContext, ModuleIndex, iter_python_files,
+                      run_ast_checks)
+from . import registry
+
+__all__ = ["AST_RULES", "Finding", "Rule", "Suppressions", "rule_table",
+           "FileContext", "ModuleIndex", "iter_python_files",
+           "run_ast_checks", "registry", "run_jaxpr_checks"]
+
+
+def run_jaxpr_checks(*args, **kwargs):
+    """Lazy facade over `jaxpr_checks.run_jaxpr_checks` — level 2 imports
+    jax; level 1 must stay importable without it."""
+    from .jaxpr_checks import run_jaxpr_checks as impl
+    return impl(*args, **kwargs)

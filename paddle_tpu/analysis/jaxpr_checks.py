@@ -1,0 +1,271 @@
+"""tpu_lint level 2: jaxpr audits of the serving executables.
+
+Level 1 reads source; this level reads what jax will actually compile.  Each
+registry-declared serving executable is traced with abstract inputs
+(`jax.make_jaxpr` — tracing only, no XLA compile) and the closed jaxpr is
+audited:
+
+- **JXP001** transfer primitives inside the program (`device_put`, host
+  callbacks): a serving step must be pure device compute — an embedded
+  transfer is a hidden per-dispatch host round-trip that no AST pattern can
+  see once it hides behind a helper.
+- **JXP002** donation mismatch, both directions: every declared-donated
+  buffer (the KV page pool) must actually arrive donated in the pjit params
+  (else XLA double-buffers the pool every step), and declared-persistent
+  buffers (params, reused across calls) must NOT be donated (else the second
+  dispatch reads freed memory).  Any other large undeclared input that is
+  not donated is flagged too.
+- **JXP003** dtype upcasts: float64 anywhere in the program (a leaked Python
+  float / np.float64 under x64) or an upcast `convert_element_type` to f64.
+- **JXP004** (mp mode) missing sharding constraint: the tensor-parallel
+  executables must pin their output pool layout (`pin_pool`'s
+  `with_sharding_constraint`) — without the pin, GSPMD-inferred output
+  shardings drift between calls and the fixed program set silently forks.
+
+`audit_jaxpr` is the reusable core (tests feed it toy jits for
+positive/negative pairs); `run_jaxpr_checks` builds a tiny CPU engine and
+audits the real serving set, plus an mp=2 pass when enough devices exist.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+from .rules import Finding
+
+TRANSFER_PRIMITIVES = frozenset({
+    "device_put", "pure_callback", "io_callback", "debug_callback",
+    "infeed", "outfeed"})
+
+LARGE_LEAF_ELEMS = 1 << 16      # "large" for the undeclared-buffer check
+
+
+def _iter_eqns(jaxpr):
+    """Every eqn in `jaxpr` and its nested sub-jaxprs (pjit bodies, scan/cond
+    branches, custom_vjp calls...)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _as_jaxprs(v):
+                yield from _iter_eqns(sub)
+
+
+def _as_jaxprs(value):
+    from jax.core import ClosedJaxpr, Jaxpr
+    if isinstance(value, ClosedJaxpr):
+        yield value.jaxpr
+    elif isinstance(value, Jaxpr):
+        yield value
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            yield from _as_jaxprs(v)
+
+
+def _arg_paths(args) -> List[str]:
+    """Human-readable path per flattened leaf of `args`, aligned with the
+    pjit eqn's invar order: 'arg2[k][0]' style."""
+    import jax
+    leaves_with_path = jax.tree_util.tree_flatten_with_path(tuple(args))[0]
+    out = []
+    for path, _ in leaves_with_path:
+        s = ""
+        for i, key in enumerate(path):
+            if i == 0:
+                s = f"arg{getattr(key, 'idx', key)}"
+            else:
+                s += jax.tree_util.keystr((key,))
+        out.append(s)
+    return out
+
+
+def _under(path: str, prefixes: Sequence[str]) -> bool:
+    return any(path == p or path.startswith(p + "[") or
+               path.startswith(p + ".") for p in prefixes)
+
+
+def audit_jaxpr(name: str, fn, args, *, donate_paths: Sequence[str] = (),
+                keep_paths: Sequence[str] = (),
+                require_sharding_constraint: bool = False,
+                large_leaf_elems: int = LARGE_LEAF_ELEMS) -> List[Finding]:
+    """Trace `fn(*args)` (a jitted callable) and run every jaxpr check.
+    Findings carry the pseudo-path `<jaxpr:name>` — they live in the traced
+    program, not on a source line."""
+    import jax
+    import numpy as np
+
+    path = f"<jaxpr:{name}>"
+    findings: List[Finding] = []
+    closed = jax.make_jaxpr(fn)(*args)
+
+    # the jitted callable traces to a single pjit eqn carrying the program
+    pjit_eqn = None
+    for eqn in closed.jaxpr.eqns:
+        if eqn.primitive.name == "pjit":
+            pjit_eqn = eqn
+            break
+
+    # ---- JXP001: transfers inside the program -----------------------------
+    for eqn in _iter_eqns(closed.jaxpr):
+        if eqn.primitive.name in TRANSFER_PRIMITIVES and eqn is not pjit_eqn:
+            findings.append(Finding(
+                "JXP001", path, 0, 0,
+                f"`{eqn.primitive.name}` primitive inside the program — a "
+                f"hidden per-dispatch transfer/host round-trip"))
+
+    # ---- JXP002: donation, both directions --------------------------------
+    if pjit_eqn is not None:
+        donated = pjit_eqn.params.get("donated_invars", ())
+        paths = _arg_paths(args)
+        if len(paths) == len(donated):
+            for p, d, var in zip(paths, donated, pjit_eqn.invars):
+                aval = getattr(var, "aval", None)
+                size = int(np.prod(aval.shape)) if aval is not None and \
+                    aval.shape else 1
+                if _under(p, donate_paths) and not d:
+                    findings.append(Finding(
+                        "JXP002", path, 0, 0,
+                        f"declared-donated buffer `{p}` "
+                        f"({aval.str_short() if aval else '?'}) is NOT "
+                        f"donated — XLA double-buffers it every dispatch"))
+                elif _under(p, keep_paths) and d:
+                    findings.append(Finding(
+                        "JXP002", path, 0, 0,
+                        f"persistent buffer `{p}` IS donated — the next "
+                        f"dispatch would read freed memory"))
+                elif not d and size >= large_leaf_elems and \
+                        not _under(p, keep_paths) and \
+                        not _under(p, donate_paths):
+                    findings.append(Finding(
+                        "JXP002", path, 0, 0,
+                        f"large input `{p}` ({aval.str_short()}) neither "
+                        f"donated nor declared persistent — copied every "
+                        f"dispatch; donate it or register it as kept"))
+        elif donate_paths or keep_paths:
+            findings.append(Finding(
+                "JXP002", path, 0, 0,
+                f"cannot align {len(donated)} pjit inputs with "
+                f"{len(paths)} argument leaves — donation audit skipped; "
+                f"does the traced function close over arrays?"))
+    elif donate_paths or keep_paths:
+        # the audit must fail CLOSED: if the callable was not actually jitted
+        # (make_jaxpr inlined it, no pjit eqn), a declared donation contract
+        # cannot be verified and silence would mean CI green while unguarded
+        findings.append(Finding(
+            "JXP002", path, 0, 0,
+            "no pjit eqn in the traced program (callable not jitted?) — "
+            "declared donation contract cannot be audited"))
+
+    # ---- JXP003: dtype upcasts --------------------------------------------
+    seen_f64 = False
+    for eqn in _iter_eqns(closed.jaxpr):
+        for v in list(eqn.outvars) + [x for x in eqn.invars
+                                      if hasattr(x, "aval")]:
+            aval = getattr(v, "aval", None)
+            dt = str(getattr(aval, "dtype", ""))
+            if dt == "float64" and not seen_f64:
+                seen_f64 = True
+                findings.append(Finding(
+                    "JXP003", path, 0, 0,
+                    "float64 value inside the program — a Python float / "
+                    "np.float64 leaked into the trace (4x the bf16 compute "
+                    "budget per element)"))
+        if eqn.primitive.name == "convert_element_type":
+            new = str(eqn.params.get("new_dtype", ""))
+            old = str(getattr(eqn.invars[0].aval, "dtype", "")) \
+                if hasattr(eqn.invars[0], "aval") else ""
+            if new == "float64" and old in ("float32", "bfloat16"):
+                findings.append(Finding(
+                    "JXP003", path, 0, 0,
+                    f"upcast convert_element_type {old} -> float64 inside "
+                    f"the program"))
+
+    # ---- JXP004: sharding constraint under mp -----------------------------
+    if require_sharding_constraint:
+        n = sum(1 for eqn in _iter_eqns(closed.jaxpr)
+                if eqn.primitive.name == "sharding_constraint")
+        if n == 0:
+            findings.append(Finding(
+                "JXP004", path, 0, 0,
+                "mp-mode executable has NO sharding_constraint — the output "
+                "pool layout is GSPMD-inferred and can drift between calls "
+                "(pin it with with_sharding_constraint, see engine.pin_pool)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# the real serving targets
+# ---------------------------------------------------------------------------
+
+
+def _build_engine(mp: int):
+    import jax
+
+    from ..inference.engine import LLMEngine
+    from ..models import gpt as gpt_mod
+
+    cfg = gpt_mod.gpt_tiny(64)
+    params = gpt_mod.init_params(cfg, jax.random.key(0))
+    return LLMEngine(params, cfg, num_slots=2, page_size=8, max_model_len=64,
+                     prefill_chunk=8, spec_len=2,
+                     mp=mp if mp > 1 else None), cfg
+
+
+def serving_targets(mp: int = 1) -> List[Tuple[str, object, tuple, dict]]:
+    """(name, jitted fn, example args, audit kwargs) for every serving
+    executable, mirroring the engine's own dispatch shapes (warm_decode /
+    warm_spec / chunk / bucketed-prefill / COW copy)."""
+    import jax.numpy as jnp
+
+    eng, _cfg = _build_engine(mp)
+    B = eng.cache.num_slots
+    P = eng.cache.max_pages_per_slot
+    i32 = jnp.int32
+    tag = f"mp{mp}." if mp > 1 else ""
+    mp_kw = dict(require_sharding_constraint=mp > 1)
+
+    def unwrap(fn):
+        return getattr(fn, "_jit", fn)     # _AotCache under mp, jit else
+
+    C = eng.prefill_chunk
+    bucket = eng.buckets[0]
+    T = eng.spec_len + 1
+    return [
+        (f"serve.{tag}decode", unwrap(eng._decode_fn),
+         (eng.params, jnp.zeros((B,), i32), eng._pool,
+          jnp.zeros((B, P), i32), jnp.zeros((B,), i32), eng._key,
+          jnp.zeros((B,), bool)),
+         dict(donate_paths=("arg2",), keep_paths=("arg0",), **mp_kw)),
+        (f"serve.{tag}chunk_prefill", unwrap(eng._chunk_fn),
+         (eng.params, jnp.zeros((1, C), i32), eng._pool,
+          jnp.zeros((1, P), i32), jnp.zeros((1,), i32),
+          jnp.ones((1,), i32), eng._key, jnp.zeros((1,), bool)),
+         dict(donate_paths=("arg2",), keep_paths=("arg0",), **mp_kw)),
+        (f"serve.{tag}bucketed_prefill", unwrap(eng._prefill_fn),
+         (eng.params, jnp.zeros((1, bucket), i32), eng._pool,
+          jnp.zeros((1, bucket // eng.cache.page_size), i32),
+          jnp.ones((1,), i32), eng._key, jnp.zeros((1,), bool)),
+         dict(donate_paths=("arg2",), keep_paths=("arg0",), **mp_kw)),
+        (f"serve.{tag}verify", unwrap(eng._verify_fn),
+         (eng.params, jnp.zeros((B, T), i32), eng._pool,
+          jnp.zeros((B, P), i32), jnp.zeros((B,), i32),
+          jnp.ones((B,), i32)),
+         dict(donate_paths=("arg2",), keep_paths=("arg0",), **mp_kw)),
+        (f"serve.{tag}cow_copy", unwrap(eng._copy_fn),
+         (eng._pool, jnp.zeros((), i32), jnp.ones((), i32)),
+         dict(donate_paths=("arg0",), **mp_kw)),
+    ]
+
+
+def run_jaxpr_checks(include_mp: bool = True,
+                     mp: int = 2) -> List[Finding]:
+    """Audit every serving executable's jaxpr; adds the mp pass when the
+    host exposes enough devices (CI forces 8 virtual CPU chips)."""
+    import jax
+
+    findings: List[Finding] = []
+    passes: List[int] = [1]
+    if include_mp and len(jax.devices()) >= mp:
+        passes.append(mp)
+    for m in passes:
+        for name, fn, args, kw in serving_targets(m):
+            findings.extend(audit_jaxpr(name, fn, args, **kw))
+    return findings

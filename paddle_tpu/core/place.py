@@ -77,7 +77,7 @@ def _kind(dev) -> str:
 def _accelerator_available() -> bool:
     try:
         return any(_kind(d) == "tpu" for d in jax.devices())
-    except Exception:
+    except RuntimeError:        # no backend could initialize
         return False
 
 
@@ -133,5 +133,5 @@ def is_compiled_with_rocm() -> bool:
 def device_count() -> int:
     try:
         return len([d for d in jax.devices() if _kind(d) == "tpu"]) or 1
-    except Exception:
+    except RuntimeError:        # no backend could initialize
         return 1

@@ -84,6 +84,7 @@ class HybridParallelGradScaler:
             found = Tensor(jnp.asarray([1.0 if self._scaler._found_inf else 0.0]))
             if self._hcg and self._hcg.get_model_parallel_world_size() > 1:
                 all_reduce(found, ReduceOp.MAX, group=self._hcg.get_model_parallel_group())
+            # tpu-lint: disable=TPL001 -- scaler skip after the cross-chip MAX is a host branch; one scalar sync per step by design
             self._scaler._found_inf = bool(found._data[0] > 0)
             self._scaler._unscaled = True
         self._scaler.step(inner)

@@ -90,6 +90,7 @@ def init_parallel_env():
                 jax.distributed.initialize(coordinator_address=coord,
                                            num_processes=env.world_size,
                                            process_id=env.rank)
+            # tpu-lint: disable=TPL006 -- multi-process init is best-effort (already-initialized, single-host sim, no coordinator); degrades to local mode with a warning
             except Exception as e:  # already initialized or single-host sim
                 if "already" not in str(e):
                     import warnings

@@ -37,7 +37,7 @@ def _on_tpu() -> bool:
     try:
         return jax.default_backend() in ("tpu",) or \
             jax.devices()[0].platform in ("tpu", "axon")
-    except Exception:
+    except (RuntimeError, IndexError):   # backend init failed / no devices
         return False
 
 

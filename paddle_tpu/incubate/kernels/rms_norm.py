@@ -21,7 +21,7 @@ def _rms_kernel(x_ref, w_ref, o_ref, *, eps: float):
 def _on_tpu() -> bool:
     try:
         return jax.devices()[0].platform in ("tpu", "axon")
-    except Exception:
+    except (RuntimeError, IndexError):   # backend init failed / no devices
         return False
 
 

@@ -154,7 +154,10 @@ class RequestOutput:
 
     @property
     def tokens(self) -> np.ndarray:
-        """prompt + generated, the `generate()`-compatible view."""
+        """prompt + generated, the `generate()`-compatible view.  Both inputs
+        are host data by construction (add_request normalizes the prompt to
+        numpy; token_ids are Python ints synced during step()), so these
+        np.asarray calls never touch the device."""
         return np.concatenate(
             [np.asarray(self.prompt, np.int64), np.asarray(self.token_ids,
                                                            np.int64)])
@@ -358,9 +361,11 @@ class LLMEngine:
             # recompile per jit on the second call)
             self._pool_sharding = jsh.NamedSharding(
                 mesh, jsh.PartitionSpec(None, None, None, "mp"))
+            self._repl_sharding = jsh.NamedSharding(mesh, jsh.PartitionSpec())
         else:
             self._param_shardings = None
             self._pool_sharding = None
+            self._repl_sharding = None
         self.params = params
         self.config = config
         self.eos_token_id = eos_token_id
@@ -700,6 +705,19 @@ class LLMEngine:
                 return b
         raise ValueError(f"no bucket for prompt length {n}")
 
+    def _h2d(self, a, dtype=None):
+        """Host->device for per-step scheduler inputs (tokens, page tables,
+        lengths, flags): numpy-first + EXPLICIT placement, so the
+        steady-state decode loop runs clean under
+        `jax.transfer_guard("disallow")` — a bare Python list/int through
+        `jnp.asarray` is an implicit transfer, and under mp a single-device
+        array would be implicitly resharded to the mesh at every AOT
+        dispatch."""
+        a = np.asarray(a, dtype)
+        if self._repl_sharding is not None:
+            return jax.device_put(a, self._repl_sharding)
+        return jnp.asarray(a)
+
     def _span(self, name: str):
         """A profiler span for one host phase — real only while a trace is
         recording (engine.trace() or a user Profiler); the steady-state step
@@ -794,8 +812,8 @@ class LLMEngine:
                 # own page before anything is appended into it
                 src, dst = cow
                 self._pool = self._copy_fn(self._pool,
-                                           jnp.asarray(src, jnp.int32),
-                                           jnp.asarray(dst, jnp.int32))
+                                           self._h2d(src, np.int32),
+                                           self._h2d(dst, np.int32))
                 self._cow_copies.inc()
                 self._copy_used = True
             if matched:
@@ -810,15 +828,16 @@ class LLMEngine:
                 pages = row[:bucket // mgr.page_size][None, :]
                 with self._span("engine.prefill.dispatch"):
                     first, self._pool, self._key = self._prefill_fn(
-                        self.params, jnp.asarray(ids), self._pool,
-                        jnp.asarray(pages), jnp.asarray([lp], jnp.int32),
-                        self._key, jnp.asarray([self._req_greedy(req)]))
+                        self.params, self._h2d(ids), self._pool,
+                        self._h2d(pages), self._h2d([lp], np.int32),
+                        self._key, self._h2d([self._req_greedy(req)]))
                 self._seen_buckets.add(bucket)
                 self._prefilled_tokens.inc(lp)
                 if self.prefix_cache:
                     mgr.register_prefix(slot, req.prompt, lp)
-                self._start_decoding(req, slot, int(np.asarray(first)[0]), 0,
-                                     finished)
+                with self._span("engine.sample.sync"):
+                    first = int(np.asarray(first)[0])   # blocks on the result
+                self._start_decoding(req, slot, first, 0, finished)
             else:
                 self._prefilling[slot] = _Prefilling(req, slot, matched,
                                                      matched)
@@ -838,11 +857,11 @@ class LLMEngine:
         ids[0, :n] = st.request.prompt[st.filled:st.filled + n]
         with self._span("engine.prefill.dispatch"):
             tok, self._pool, self._key = self._chunk_fn(
-                self.params, jnp.asarray(ids), self._pool,
-                jnp.asarray(mgr.page_table[slot][None, :]),
-                jnp.asarray([st.filled], jnp.int32),
-                jnp.asarray([n], jnp.int32),
-                self._key, jnp.asarray([self._req_greedy(st.request)]))
+                self.params, self._h2d(ids), self._pool,
+                self._h2d(mgr.page_table[slot][None, :]),
+                self._h2d([st.filled], np.int32),
+                self._h2d([n], np.int32),
+                self._key, self._h2d([self._req_greedy(st.request)]))
         self._chunk_used = True
         self._prefill_chunks.inc()
         self._prefilled_tokens.inc(n)
@@ -851,8 +870,10 @@ class LLMEngine:
             mgr.register_prefix(slot, st.request.prompt, st.filled)
         if st.filled == lp:
             del self._prefilling[slot]
-            self._start_decoding(st.request, slot, int(np.asarray(tok)[0]),
-                                 st.cached_tokens, finished)
+            with self._span("engine.sample.sync"):
+                tok = int(np.asarray(tok)[0])           # blocks on the result
+            self._start_decoding(st.request, slot, tok, st.cached_tokens,
+                                 finished)
 
     def _start_decoding(self, req: Request, slot: int, first: int,
                         cached: int, finished: List[RequestOutput]) -> None:
@@ -955,8 +976,8 @@ class LLMEngine:
             qoff[slot] = mgr.lengths[slot]
         with self._span("engine.verify.dispatch"):
             preds, self._pool = self._verify_fn(
-                self.params, jnp.asarray(tokens), self._pool,
-                jnp.asarray(table), jnp.asarray(qoff), jnp.asarray(valid))
+                self.params, self._h2d(tokens), self._pool,
+                self._h2d(table), self._h2d(qoff), self._h2d(valid))
         with self._span("engine.sample.sync"):
             preds = np.asarray(preds)       # blocks on the device result
         self._verify_steps.inc()
@@ -1025,9 +1046,9 @@ class LLMEngine:
                 table[slot, :] = 0
         with self._span("engine.decode.dispatch"):
             nxt, self._pool, self._key = self._decode_fn(
-                self.params, jnp.asarray(tokens), self._pool,
-                jnp.asarray(table), jnp.asarray(mgr.lengths), self._key,
-                jnp.asarray(greedy))
+                self.params, self._h2d(tokens), self._pool,
+                self._h2d(table), self._h2d(mgr.lengths), self._key,
+                self._h2d(greedy))
         self._decode_tokens.inc(len(active))
         with self._span("engine.sample.sync"):
             nxt = np.asarray(nxt)           # blocks on the device result
@@ -1046,9 +1067,10 @@ class LLMEngine:
             return
         B, T = self.cache.num_slots, self.spec_len + 1
         _, self._pool = self._verify_fn(
-            self.params, jnp.zeros((B, T), jnp.int32), self._pool,
-            jnp.zeros((B, self.cache.max_pages_per_slot), jnp.int32),
-            jnp.zeros((B,), jnp.int32), jnp.ones((B,), jnp.int32))
+            self.params, self._h2d(np.zeros((B, T), np.int32)), self._pool,
+            self._h2d(np.zeros((B, self.cache.max_pages_per_slot), np.int32)),
+            self._h2d(np.zeros((B,), np.int32)),
+            self._h2d(np.ones((B,), np.int32)))
 
     def warm_decode(self) -> None:
         """Compile the vanilla decode executable against inert inputs — a
@@ -1058,9 +1080,10 @@ class LLMEngine:
         any real decode dispatch would."""
         B = self.cache.num_slots
         _, self._pool, self._key = self._decode_fn(
-            self.params, jnp.zeros((B,), jnp.int32), self._pool,
-            jnp.zeros((B, self.cache.max_pages_per_slot), jnp.int32),
-            jnp.zeros((B,), jnp.int32), self._key, jnp.zeros((B,), bool))
+            self.params, self._h2d(np.zeros((B,), np.int32)), self._pool,
+            self._h2d(np.zeros((B, self.cache.max_pages_per_slot), np.int32)),
+            self._h2d(np.zeros((B,), np.int32)), self._key,
+            self._h2d(np.zeros((B,), bool)))
 
     def _maybe_finish(self, seq: _Running,
                       finished: List[RequestOutput]) -> bool:

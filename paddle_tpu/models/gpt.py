@@ -1087,6 +1087,7 @@ def generate(params, input_ids, config: GPTConfig, max_new_tokens: int = 32,
                     jnp.arange(Tp, total - 1))
             return tokens
 
+        # tpu-lint: disable=TPL003 -- params are REUSED across generate() calls (the executable is LRU-cached); donating them would invalidate the caller's buffers
         fn = jax.jit(impl)
         global _generate_compiles
         _generate_compiles += 1

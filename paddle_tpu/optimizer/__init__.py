@@ -321,6 +321,7 @@ class LBFGS(Optimizer):
         if self._prev_flat_grad is not None and self._prev_step is not None:
             y_new = g - self._prev_flat_grad
             s_new = self._prev_step
+            # tpu-lint: disable=TPL001 -- L-BFGS curvature acceptance is inherently a host decision (python-list history); one scalar sync per step
             if float(jnp.dot(y_new, s_new)) > 1e-10:  # keep B positive-definite
                 self._s.append(s_new)
                 self._y.append(y_new)

@@ -189,6 +189,7 @@ class Profiler:
                 import jax.profiler
                 self._jax_dir = os.path.join(self._log_dir, f"jaxtrace_{int(time.time())}")
                 jax.profiler.start_trace(self._jax_dir)
+            # tpu-lint: disable=TPL006 -- device capture is best-effort: ANY backend failure must degrade to host-only tracing, not kill the run
             except Exception:
                 self._jax_dir = None
 
@@ -199,6 +200,7 @@ class Profiler:
             try:
                 import jax.profiler
                 jax.profiler.stop_trace()
+            # tpu-lint: disable=TPL006 -- stop must mirror the best-effort start: a capture that failed to open raises here, host spans still flush
             except Exception:
                 pass
             self._jax_dir = None

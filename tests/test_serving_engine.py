@@ -263,6 +263,45 @@ def test_eval_loss_jitted_once():
     np.testing.assert_allclose(a, ref, rtol=1e-5)
 
 
+def test_steady_state_decode_loop_transfer_guard_clean():
+    """Satellite (runtime twin of tpu_lint TPL001/TPL005): once every
+    executable is warm, the engine's decode loop performs NO implicit
+    host<->device transfers — every h2d is an explicit numpy-backed
+    `_h2d` placement and every d2h an explicit np.asarray inside a
+    sample-sync span.  `jax.transfer_guard("disallow")` turns any
+    regression (a bare Python scalar into a dispatch, an implicit mp
+    reshard) into an immediate error.  Exercises chunked prefill,
+    prefix-hit + COW admission, speculative verify and vanilla decode
+    inside the guard."""
+    cfg = G.gpt_tiny(64)
+    params = G.init_params(cfg, jax.random.key(0))
+    eng = LLMEngine(params, cfg, num_slots=2, page_size=8, max_model_len=64,
+                    num_pages=32, prefill_chunk=16, spec_len=3)
+    # pool big enough that the donor's cached pages survive (no LRU eviction
+    # between donor retirement and the extension's admission)
+    rng = np.random.RandomState(0)
+    for n in (5, 20):                   # warm chunk/decode/verify paths
+        eng.add_request(rng.randint(0, cfg.vocab_size, (n,))
+                        .astype(np.int32), max_new_tokens=4)
+    eng.run()
+    eng.warm_decode()
+    eng.warm_spec()
+    base = rng.randint(0, cfg.vocab_size, (13,)).astype(np.int32)
+    eng.add_request(base, max_new_tokens=1)
+    eng.run()                           # donor registers its prompt pages
+    rids = [eng.add_request(rng.randint(0, cfg.vocab_size, (n,))
+                            .astype(np.int32), max_new_tokens=5)
+            for n in (7, 19, 33)]
+    # extension of the donor: prefix hit + COW page copy inside the guard
+    rids.append(eng.add_request(np.concatenate([base, base[:4]]),
+                                max_new_tokens=3))
+    with jax.transfer_guard("disallow"):
+        outs = eng.run()
+    assert sorted(rids) == sorted(o for o in outs
+                                  if o >= rids[0])    # all guarded reqs done
+    assert eng.stats()["prefix_cached_tokens"] > 0    # the COW lane ran
+
+
 def test_bench_serve_cpu_smoke():
     """Satellite (CI wiring): the serving bench's CPU smoke completes N
     requests within the compiled-program bound."""

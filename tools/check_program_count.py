@@ -24,6 +24,12 @@ Runs the bench_serve CPU smoke (chunked prefill + prefix cache + speculative
 decoding — every lane the scheduler can dispatch) and exits non-zero with a
 diff against the budget on violation.
 
+The budget itself is DECLARED in `paddle_tpu/analysis/registry.py` (the
+central program registry) — this script re-measures the live counts against
+it, and `tools/tpu_lint.py` (TPL002) statically verifies no unregistered
+jit/shard_map site can mint programs outside it.  One declaration, two
+guards: the runtime check and the linter cannot drift apart.
+
 Usage: JAX_PLATFORMS=cpu python tools/check_program_count.py
 """
 from __future__ import annotations
@@ -40,20 +46,9 @@ if "--xla_force_host_platform_device_count" not in \
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
                                " --xla_force_host_platform_device_count=8")
 
-BUDGET = {
-    "decode_side_executables": 2,   # decode + verify
-    "prefill_executables": 2,
-    "copy_executables": 1,
-    "total_executables": 5,
-}
-# mp gets one extra total slot: the AOT path pre-compiles nothing, but the
-# issue-level contract is decode-side <= 2 and total <= 6 per mesh config
-BUDGET_MP = {
-    "decode_side_executables": 2,
-    "prefill_executables": 2,
-    "copy_executables": 1,
-    "total_executables": 6,
-}
+from paddle_tpu.analysis.registry import (  # noqa: E402
+    SERVE_PROGRAM_BUDGET as BUDGET,
+    SERVE_PROGRAM_BUDGET_MP as BUDGET_MP)
 
 
 def measure(mp=1):
