@@ -30,6 +30,15 @@ The decode and verify executables are compiled during warmup
 (`LLMEngine.warm_decode`/`warm_spec`) so the timed section measures
 steady-state serving.
 
+The engine defaults to the fused ONE-dispatch step (decode + interleaved
+chunk + verify in a single program, on-device sampling, double-buffered
+scheduling); `--no-fuse` is the escape hatch back to the legacy three-program
+step, and the default run replays the same stream unfused to report
+`fused_speedup` and byte-exact `fuse_parity`.  The JSON carries
+`dispatches_per_step` (decode-path program dispatches per dispatching step —
+1.0 fused) and `host_sync_ms_per_step` (blocking d2h sync time) straight from
+the step timeline.
+
 `--mp N` serves tensor-parallel over N chips: Megatron-sharded serving params
 (qkv/fc1 column-, proj/fc2 row-split), page pool head-sharded, paged
 attention per-chip on the local head slice.  Greedy outputs are
@@ -61,7 +70,7 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
                     page_size=8, max_model_len=None, max_new_tokens=8,
                     request_rate=float("inf"), seed=0, params=None,
                     prefill_chunk=None, prefix_cache=True,
-                    shared_prefix_frac=0.0, spec_len=0, mp=1,
+                    shared_prefix_frac=0.0, spec_len=0, mp=1, fuse=True,
                     trace_dir=None):
     """Replay a Poisson request stream through LLMEngine; returns the metrics
     dict (also the CI smoke entrypoint — tests assert on the executable
@@ -92,8 +101,10 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
 
     eng = LLMEngine(params, config, num_slots=num_slots, page_size=page_size,
                     max_model_len=max_model_len, prefill_chunk=prefill_chunk,
-                    prefix_cache=prefix_cache, spec_len=spec_len,
-                    mp=mp if mp and mp > 1 else None)
+                    prefix_cache=prefix_cache, spec_len=spec_len, fuse=fuse,
+                    mp=mp if mp and mp > 1 else None,
+                    trace_ring=4096)    # ring must hold the whole timed run
+                                        # for the dispatches/sync aggregates
     rng = np.random.RandomState(seed)
     max_prompt = max_model_len - max_new_tokens
     shared = None
@@ -198,8 +209,22 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
     # an mp mesh uses exactly mp chips; single-chip serving uses one program
     # on however many devices the host exposes (forced-CPU CI counts them all)
     n_chips = eng.mp if eng.mp > 1 else max(1, len(jax.devices()))
+    # dispatch/sync aggregates from the step timeline: decode-path program
+    # dispatches (fused/decode/verify/chunk-interleave; the admission-time
+    # one-shot prefill is the cold path) and blocking host-sync time, both
+    # averaged over the steps that dispatched anything — the one-dispatch
+    # claim in numbers (fused: 1.0; unfused busy steps: up to 3)
+    timeline = eng.step_trace()
+    busy = [r for r in timeline if r["dispatches"] > 0]
+    dispatches_per_step = (sum(r["dispatches"] for r in busy) / len(busy)
+                           if busy else 0.0)
+    host_sync_ms = (sum(r["sync_ms"] for r in timeline) / len(busy)
+                    if busy else 0.0)
     return {
         "mp": eng.mp,
+        "fused": eng.fused,
+        "dispatches_per_step": round(dispatches_per_step, 3),
+        "host_sync_ms_per_step": round(host_sync_ms, 4),
         "decode_tokens_per_sec_per_chip": round(decode_tokens / dt / n_chips, 1),
         "generated_tokens_per_sec": round(num_requests * max_new_tokens / dt, 1),
         "requests": num_requests,
@@ -259,6 +284,11 @@ def main():
                          "(default: bucketed one-shot prefill)")
     ap.add_argument("--no-prefix-cache", action="store_true",
                     help="disable copy-on-write prefix page sharing")
+    ap.add_argument("--no-fuse", action="store_true",
+                    help="disable the fused one-dispatch step: legacy "
+                         "three-program scheduling (decode + chunk + verify "
+                         "programs, host-side sampling) — the A/B baseline; "
+                         "also skips the fused-vs-unfused comparison pass")
     ap.add_argument("--spec-len", type=int, default=4,
                     help="speculative decoding draft length (n-gram "
                          "self-drafting + one K+1-token verify executable)")
@@ -314,12 +344,14 @@ def main():
                   request_rate=float("inf") if args.request_rate is None
                   else args.request_rate)
         metric = "serve_decode_tokens_per_sec (cpu smoke)"
-    stats = run_serve_bench(spec_len=spec_len, trace_dir=args.trace_dir, **kw)
+    fuse = not args.no_fuse
+    stats = run_serve_bench(spec_len=spec_len, fuse=fuse,
+                            trace_dir=args.trace_dir, **kw)
     if spec_len:
         # spec on/off delta on the SAME stream: greedy acceptance is lossless,
         # so the digests must match and the tokens/s ratio is the honest win
         # (comparison pass untraced: tracing overhead must not skew the ratio)
-        base = run_serve_bench(spec_len=0, **kw)
+        base = run_serve_bench(spec_len=0, fuse=fuse, **kw)
         stats["no_spec_decode_tokens_per_sec_per_chip"] = \
             base["decode_tokens_per_sec_per_chip"]
         stats["spec_speedup"] = round(
@@ -327,6 +359,22 @@ def main():
             max(base["decode_tokens_per_sec_per_chip"], 1e-9), 3)
         stats["spec_parity"] = \
             stats["outputs_digest"] == base["outputs_digest"]
+    if fuse:
+        # fused vs three-program A/B on the SAME stream (the --no-fuse
+        # escape hatch as one flag): greedy parity must be byte-exact, and
+        # the dispatch win shows as dispatches_per_step 1.0 vs up to 3 plus
+        # the tokens/s ratio (on TPU the dispatch overhead is the payoff; on
+        # CPU the bar is "no regression")
+        unfused = run_serve_bench(spec_len=spec_len, fuse=False, **kw)
+        stats["no_fuse_decode_tokens_per_sec_per_chip"] = \
+            unfused["decode_tokens_per_sec_per_chip"]
+        stats["no_fuse_dispatches_per_step"] = \
+            unfused["dispatches_per_step"]
+        stats["fused_speedup"] = round(
+            stats["decode_tokens_per_sec_per_chip"] /
+            max(unfused["decode_tokens_per_sec_per_chip"], 1e-9), 3)
+        stats["fuse_parity"] = \
+            stats["outputs_digest"] == unfused["outputs_digest"]
     print(json.dumps({"metric": metric,
                       "value": stats["decode_tokens_per_sec_per_chip"],
                       "unit": "tokens/s/chip", **stats}))

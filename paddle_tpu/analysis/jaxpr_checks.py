@@ -21,10 +21,19 @@ audited:
   executables must pin their output pool layout (`pin_pool`'s
   `with_sharding_constraint`) — without the pin, GSPMD-inferred output
   shardings drift between calls and the fixed program set silently forks.
+- **JXP005** oversized host-visible output: the fused one-dispatch step
+  moved sampling and spec acceptance on device precisely so the per-step
+  host fetch is O(B*K) ints — this audit bounds the program's non-donated
+  output elements (`host_output_budget`) and flags any float matrix output
+  (logits-shaped), so a refactor cannot quietly reintroduce the `[B, V]`
+  logits fetch.  Outputs whose (shape, dtype) matches a donated input (the
+  in-place page pool) are exempt: they never cross to the host.
 
 `audit_jaxpr` is the reusable core (tests feed it toy jits for
-positive/negative pairs); `run_jaxpr_checks` builds a tiny CPU engine and
-audits the real serving set, plus an mp=2 pass when enough devices exist.
+positive/negative pairs); `run_jaxpr_checks` builds tiny CPU engines (the
+default fused engine AND the `fuse=False` legacy trio, so the `--no-fuse`
+escape hatch stays audited) and checks the real serving set, plus an mp=2
+pass when enough devices exist.
 """
 from __future__ import annotations
 
@@ -85,6 +94,7 @@ def _under(path: str, prefixes: Sequence[str]) -> bool:
 def audit_jaxpr(name: str, fn, args, *, donate_paths: Sequence[str] = (),
                 keep_paths: Sequence[str] = (),
                 require_sharding_constraint: bool = False,
+                host_output_budget: Optional[int] = None,
                 large_leaf_elems: int = LARGE_LEAF_ELEMS) -> List[Finding]:
     """Trace `fn(*args)` (a jitted callable) and run every jaxpr check.
     Findings carry the pseudo-path `<jaxpr:name>` — they live in the traced
@@ -178,6 +188,40 @@ def audit_jaxpr(name: str, fn, args, *, donate_paths: Sequence[str] = (),
                     f"upcast convert_element_type {old} -> float64 inside "
                     f"the program"))
 
+    # ---- JXP005: oversized host-visible output ----------------------------
+    if host_output_budget is not None:
+        donated_sigs: List[Tuple[tuple, str]] = []
+        if pjit_eqn is not None:
+            for d, var in zip(pjit_eqn.params.get("donated_invars", ()),
+                              pjit_eqn.invars):
+                aval = getattr(var, "aval", None)
+                if d and aval is not None:
+                    donated_sigs.append((tuple(aval.shape), str(aval.dtype)))
+        small_elems = 0
+        for aval in closed.out_avals:
+            sig = (tuple(aval.shape), str(aval.dtype))
+            if sig in donated_sigs:
+                # an output shaped exactly like a donated input is the
+                # in-place buffer (page pool) riding through — never fetched
+                donated_sigs.remove(sig)
+                continue
+            # extended-dtype-aware floating check: bfloat16 (the TPU serving
+            # dtype) must be caught too, and PRNG key dtypes must not crash
+            if jax.dtypes.issubdtype(aval.dtype, np.floating) and \
+                    len(aval.shape) >= 2:
+                findings.append(Finding(
+                    "JXP005", path, 0, 0,
+                    f"host-visible float output {aval.str_short()} — "
+                    f"logits-shaped; the fused step must return O(B*K) int "
+                    f"tokens/accept counts, never [B, V] logits"))
+            small_elems += int(np.prod(aval.shape)) if aval.shape else 1
+        if small_elems > host_output_budget:
+            findings.append(Finding(
+                "JXP005", path, 0, 0,
+                f"host-visible output totals {small_elems} elements (budget "
+                f"{host_output_budget}) — the per-step fetch must stay "
+                f"O(B*K) ints or the fused step's sync win is gone"))
+
     # ---- JXP004: sharding constraint under mp -----------------------------
     if require_sharding_constraint:
         n = sum(1 for eqn in _iter_eqns(closed.jaxpr)
@@ -196,7 +240,7 @@ def audit_jaxpr(name: str, fn, args, *, donate_paths: Sequence[str] = (),
 # ---------------------------------------------------------------------------
 
 
-def _build_engine(mp: int):
+def _build_engine(mp: int, fuse: bool = True):
     import jax
 
     from ..inference.engine import LLMEngine
@@ -205,17 +249,22 @@ def _build_engine(mp: int):
     cfg = gpt_mod.gpt_tiny(64)
     params = gpt_mod.init_params(cfg, jax.random.key(0))
     return LLMEngine(params, cfg, num_slots=2, page_size=8, max_model_len=64,
-                     prefill_chunk=8, spec_len=2,
+                     prefill_chunk=8, spec_len=2, fuse=fuse,
                      mp=mp if mp > 1 else None), cfg
 
 
 def serving_targets(mp: int = 1) -> List[Tuple[str, object, tuple, dict]]:
     """(name, jitted fn, example args, audit kwargs) for every serving
-    executable, mirroring the engine's own dispatch shapes (warm_decode /
-    warm_spec / chunk / bucketed-prefill / COW copy)."""
+    executable, mirroring the engine's own dispatch shapes.  Two engines:
+    the default FUSED engine supplies the one-dispatch step (audited under
+    JXP001-005 — the host-output budget proves the O(B*K)-int fetch), the
+    bucketed cold prefill and the COW copy; a `fuse=False` engine supplies
+    the legacy decode/chunk/verify trio so the --no-fuse escape hatch stays
+    under the same donation/transfer/dtype discipline."""
     import jax.numpy as jnp
 
     eng, _cfg = _build_engine(mp)
+    leg, _ = _build_engine(mp, fuse=False)
     B = eng.cache.num_slots
     P = eng.cache.max_pages_per_slot
     i32 = jnp.int32
@@ -225,27 +274,34 @@ def serving_targets(mp: int = 1) -> List[Tuple[str, object, tuple, dict]]:
     def unwrap(fn):
         return getattr(fn, "_jit", fn)     # _AotCache under mp, jit else
 
-    C = eng.prefill_chunk
+    C = leg.prefill_chunk
     bucket = eng.buckets[0]
-    T = eng.spec_len + 1
+    T = leg.spec_len + 1
+    Tf = eng._fused_T
     return [
-        (f"serve.{tag}decode", unwrap(eng._decode_fn),
-         (eng.params, jnp.zeros((B,), i32), eng._pool,
-          jnp.zeros((B, P), i32), jnp.zeros((B,), i32), eng._key,
+        (f"serve.{tag}fused_step", unwrap(eng._decode_fn),
+         (eng.params, jnp.zeros((B, Tf), i32), eng._pool,
+          jnp.zeros((B, P), i32), jnp.zeros((B,), i32),
+          jnp.ones((B,), i32), eng._key, jnp.zeros((B,), bool)),
+         dict(donate_paths=("arg2",), keep_paths=("arg0",),
+              host_output_budget=B * (Tf + 2) + 2, **mp_kw)),
+        (f"serve.{tag}decode", unwrap(leg._decode_fn),
+         (leg.params, jnp.zeros((B,), i32), leg._pool,
+          jnp.zeros((B, P), i32), jnp.zeros((B,), i32), leg._key,
           jnp.zeros((B,), bool)),
          dict(donate_paths=("arg2",), keep_paths=("arg0",), **mp_kw)),
-        (f"serve.{tag}chunk_prefill", unwrap(eng._chunk_fn),
-         (eng.params, jnp.zeros((1, C), i32), eng._pool,
+        (f"serve.{tag}chunk_prefill", unwrap(leg._chunk_fn),
+         (leg.params, jnp.zeros((1, C), i32), leg._pool,
           jnp.zeros((1, P), i32), jnp.zeros((1,), i32),
-          jnp.ones((1,), i32), eng._key, jnp.zeros((1,), bool)),
+          jnp.ones((1,), i32), leg._key, jnp.zeros((1,), bool)),
          dict(donate_paths=("arg2",), keep_paths=("arg0",), **mp_kw)),
         (f"serve.{tag}bucketed_prefill", unwrap(eng._prefill_fn),
          (eng.params, jnp.zeros((1, bucket), i32), eng._pool,
           jnp.zeros((1, bucket // eng.cache.page_size), i32),
           jnp.ones((1,), i32), eng._key, jnp.zeros((1,), bool)),
          dict(donate_paths=("arg2",), keep_paths=("arg0",), **mp_kw)),
-        (f"serve.{tag}verify", unwrap(eng._verify_fn),
-         (eng.params, jnp.zeros((B, T), i32), eng._pool,
+        (f"serve.{tag}verify", unwrap(leg._verify_fn),
+         (leg.params, jnp.zeros((B, T), i32), leg._pool,
           jnp.zeros((B, P), i32), jnp.zeros((B,), i32),
           jnp.ones((B,), i32)),
          dict(donate_paths=("arg2",), keep_paths=("arg0",), **mp_kw)),

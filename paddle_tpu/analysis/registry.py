@@ -28,23 +28,29 @@ from typing import Dict, List, Optional, Tuple
 # ---------------------------------------------------------------------------
 
 # Continuous batching is only viable on TPU because the engine runs a FIXED
-# set of executables regardless of traffic shape: decode + spec-verify on the
-# decode side, the chunk executable (+ at most the bucketed ladder's top) on
-# the prefill side, one COW page copy.
+# set of executables regardless of traffic shape.  Since the one-dispatch
+# refactor the decode side is a SINGLE fused program
+# (`models/gpt.py::serve_step_paged`, built through `LLMEngine.__init__`'s
+# jit_ wrapper as `_decode_fn`): vanilla decode, spec verify and the
+# interleaved prefill chunk all ride it, with sampling and the accept scan on
+# device.  The prefill budget covers the cold paths (bucketed one-shot +
+# prefix-tail chunk in bucketed mode; zero programs in chunked mode, where
+# the chunk rides the fused batch), plus one COW page copy.
 SERVE_PROGRAM_BUDGET: Dict[str, int] = {
-    "decode_side_executables": 2,   # decode + verify
+    "decode_side_executables": 1,   # THE fused serve_step_paged program
     "prefill_executables": 2,
     "copy_executables": 1,
-    "total_executables": 5,
+    "total_executables": 4,
 }
 
 # Per-mesh-config budget under tensor parallelism: the AOT path keeps counts
-# exact; the issue-level contract is decode-side <= 2 and total <= 6.
+# exact; the contract since the one-dispatch refactor is decode-side <= 1 at
+# EVERY mesh config (the fused program partitions, it does not fork).
 SERVE_PROGRAM_BUDGET_MP: Dict[str, int] = {
-    "decode_side_executables": 2,
+    "decode_side_executables": 1,
     "prefill_executables": 2,
     "copy_executables": 1,
-    "total_executables": 6,
+    "total_executables": 4,
 }
 
 
@@ -72,8 +78,13 @@ PROGRAM_SOURCES: Tuple[ProgramSource, ...] = (
     ProgramSource(
         "paddle_tpu/inference/engine.py", "LLMEngine.__init__",
         budget="total_executables",
-        note="the five serving executables (decode/prefill/chunk/verify/"
-             "copy) built through the jit_ wrapper; fixed shapes per engine"),
+        note="the serving executables built through the jit_ wrapper, fixed "
+             "shapes per engine.  Fused (default): serve_step_paged — THE "
+             "one-dispatch step (decode + verify + interleaved chunk in one "
+             "[B, max(K+1, chunk)] batch, on-device sampling/acceptance, "
+             "O(B*K)-int host output) — plus the cold prefill paths and the "
+             "COW copy; fuse=False additionally builds the legacy decode/"
+             "chunk/verify trio (A/B baseline, outside the default budget)"),
     # ---- model core -------------------------------------------------------
     ProgramSource(
         "paddle_tpu/models/gpt.py", "generate",

@@ -331,6 +331,9 @@ JAXPR_RULE_TABLE: Tuple[Tuple[str, str, str], ...] = (
     ("JXP004", "missing-sharding-constraint",
      "mp-mode executable without a sharding_constraint pinning its output "
      "layout"),
+    ("JXP005", "oversized-host-output",
+     "serving-step output exceeds the O(B*K)-int budget or is logits-shaped "
+     "— reintroduces the per-step [B, V] host fetch the fused step removed"),
 )
 
 

@@ -36,8 +36,16 @@ at position `lengths[b] + t` and attends causally through the page table —
 exactly the prefill pair's contract with `q_offset = lengths` and per-slot
 `valid` counts (`valid = 1` degenerates to vanilla single-token decode, which
 is how undrafted slots ride the same fixed-shape verify executable).
-`paged_verify_attention` is that entry, so the decode-side program budget
-stays at two: `paged_attention_decode` (q_len 1) + the verify lane.
+`paged_verify_attention` is that entry.
+
+The fused one-dispatch serving step (`models.gpt.serve_step_paged`) takes the
+q_offset/valid contract to its conclusion: `paged_serve_attention` is the
+single attention entry behind the engine's steady-state step, where EVERY
+slot — vanilla decode (valid = 1), spec verify (valid = 1+K) and the
+interleaved prefill chunk (valid = chunk tokens) — rides one kernel grid with
+its own per-slot q_offset/valid mask.  The per-slot mode is entirely encoded
+by those masks plus the page-table row (inactive slots are null rows), so the
+decode-side program budget collapses to ONE compiled executable.
 
 Multi-chip serving (PR 4) makes every entry mesh-aware: pass `mesh=` with an
 'mp' axis and the attention runs head-sharded tensor-parallel — the
@@ -466,6 +474,22 @@ def paged_verify_attention(q, k_pages, v_pages, page_table, lengths, valid,
     the chunked-prefill pair with `q_offset = lengths` — one kernel serves
     both lanes, keeping the decode-side compiled-program count at two."""
     return paged_prefill_attention(q, k_pages, v_pages, page_table, lengths,
+                                   valid, scale=scale, mesh=mesh)
+
+
+def paged_serve_attention(q, k_pages, v_pages, page_table, q_offset, valid,
+                          scale=None, mesh=None):
+    """Entry used by `models.gpt.serve_step_paged` — the fused one-dispatch
+    engine step.  Identical math to the prefill/verify pair (causal-at-offset
+    through the page table), but the batch is heterogeneous: each slot's
+    (q_offset, valid) pair selects its mode — decode rides at valid=1 with
+    q_offset = cached length, verify at valid=1+K, a prefill chunk at
+    valid = chunk tokens with q_offset = tokens already written — and padded
+    rows (t >= valid) are masked per slot, their KV routed to the null page
+    by the caller.  One kernel serves every lane of the steady-state step,
+    which is what lets the engine dispatch exactly one program per
+    iteration."""
+    return paged_prefill_attention(q, k_pages, v_pages, page_table, q_offset,
                                    valid, scale=scale, mesh=mesh)
 
 

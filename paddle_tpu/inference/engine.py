@@ -27,7 +27,8 @@ and Orca's iteration-level scheduling (Yu et al., OSDI 2022), under the same
   (`models.gpt.prefill_chunk_paged`, any q_offset), and `step()` interleaves
   at most one chunk with each decode iteration: a 4k-token prompt no longer
   stalls every decode slot for a whole bucket-padded pass, and the prefill
-  program count collapses from #buckets to <= 2.  The legacy bucketed
+  program count collapses from #buckets to <= 2 (to 0 under the default
+  fused step, where the chunk rides the fused batch).  The legacy bucketed
   one-shot path (`prefill_paged`, power-of-2 buckets) remains the default for
   uncached prompts when `prefill_chunk=None`.
 - **Speculative decoding** (Leviathan et al. 2023; prompt-lookup drafting a la
@@ -44,14 +45,42 @@ and Orca's iteration-level scheduling (Yu et al., OSDI 2022), under the same
   still a valid greedy decode of the model).  Rejected candidates roll
   back as a per-slot length decrement (their KV is stale garbage inside the
   slot's own reserved pages, overwritten on reuse); slots with no draft ride
-  the verify program at valid=1 (plain decode), and sampled slots fall back
-  to the vanilla decode program in the same iteration — the decode-side
-  program count stays at two.
+  at valid=1 (plain decode).  Under the default fused step the verify lane
+  is part of the ONE fused program (decode-side count: 1); with `fuse=False`
+  it is its own executable and sampled slots fall back to vanilla decode in
+  the same iteration (decode-side count: 2).
 - **Scheduler** — each `step()` admits queued requests into free slots
   (reservation-based page admission with prefix matching), advances at most
   one prefill chunk, runs one decode iteration over all fully-prefilled
   slots, and retires finished sequences (EOS or max_new_tokens), returning
   their pages to the refcounted pool.
+- **One-dispatch fused step** (default, `fuse=True`; the reference's
+  single-graph `AnalysisPredictor::ZeroCopyRun` step + true Sarathi
+  piggybacking) — the steady-state step dispatches exactly ONE fixed-shape
+  program (`models.gpt.serve_step_paged`): vanilla decode slots ride at
+  valid=1, spec-verify slots at valid=1+K, and the interleaved prefill chunk
+  rides the SAME batch at valid=chunk_len (instead of its own program), with
+  per-slot mode implied by (q_offset, valid, page-table row).  Greedy argmax,
+  temperature sampling (the shared `gpt.sample_token` split-key discipline)
+  and the spec longest-prefix accept scan all run inside the program, so the
+  per-step host fetch is a `[B, K+1] + [B]` int32 token/accept buffer —
+  ~3 orders of magnitude smaller than `[B, V]` logits — and the decode-side
+  compiled-program count is ONE.  `fuse=False` keeps the legacy
+  three-program step (decode + chunk + verify, host-side sampling) as the
+  A/B baseline (`bench_serve.py --no-fuse`).
+- **Double-buffered scheduling** (`double_buffer=True`, fused mode only) —
+  the fused dispatch returns un-synced: the host finishes its step-n
+  bookkeeping and the caller's loop while the device computes, and the token
+  fetch for step n happens at the TOP of step n+1 inside the
+  `engine.sample.sync` span (by which time the result is usually ready, so
+  the sync is off the critical path).  Host scheduler state (lengths, page
+  tables, EOS/finish) is updated at harvest time, one step after dispatch;
+  `abort()` harvests the in-flight batch first so bookkeeping stays exact.
+  In-flight KV writes of a just-aborted slot are safe: the page pool threads
+  through every dispatch as a donated buffer, so device writes are program-
+  ordered — a page recycled to a new request is rewritten by the new owner's
+  prefill before its attention can read any position the stale write
+  touched.
 - **Multi-chip serving** (vLLM's Megatron-style tensor parallelism) —
   `mp=N` shards the model over N chips: Megatron serving params placed once
   at init (`parallel.hybrid.serving_param_specs`), page pool sharded on its
@@ -216,11 +245,15 @@ _NULL_SPAN = _NullSpan()
 # scheduler.  admit covers prefix matching + reservation (+ the one-shot
 # bucketed prefill when taken synchronously); dispatch spans end when the
 # async call returns, sample/accept spans contain the blocking device sync.
+# The fused step (default) dispatches through engine.fused.dispatch; the
+# decode/verify/prefill dispatch spans belong to the legacy fuse=False path
+# (prefill.dispatch also covers the bucketed cold path in fused mode).
 ENGINE_SPANS = (
     "engine.step",
     "engine.admit",
     "engine.prefill.dispatch",
     "engine.spec.propose",
+    "engine.fused.dispatch",
     "engine.verify.dispatch",
     "engine.spec.accept",
     "engine.decode.dispatch",
@@ -295,6 +328,19 @@ class LLMEngine:
     without a single accepted token stops being drafted for — it skips the
     proposer scan and rides verify at valid=1 (`stats()["spec_backoffs"]`).
 
+    `fuse=True` (default) collapses the steady-state step to ONE fixed-shape
+    dispatch with on-device sampling/acceptance (`gpt.serve_step_paged`): a
+    busy step's decode slots, verify slots and the interleaved prefill chunk
+    share one `[num_slots, max(spec_len+1, prefill_chunk)]` batch, and the
+    host fetches a small int token/accept buffer instead of `[B, V]` logits.
+    `double_buffer=True` (default in fused mode) makes the dispatch return
+    un-synced, moving the token fetch for step *n* to the top of step *n+1*
+    (inside the `engine.sample.sync` span) so the device computes while the
+    host schedules — finishes are then observed one `step()` later than in
+    synchronous mode, which `run()`/`has_work` account for.  `fuse=False` is
+    the legacy three-program step (`bench_serve.py --no-fuse`), byte-exact
+    greedy-parity with the fused path.
+
     Observability: `engine.metrics` is the metrics registry (counters,
     page/queue gauges, latency histograms; `to_prometheus()` for scraping),
     `stats()` the flat dict benches consume, `step_trace()` the per-iteration
@@ -312,7 +358,8 @@ class LLMEngine:
     (page tables, lengths, refcounts, prefix index) stays replicated host
     memory — the paging/prefix/COW logic is mp-oblivious — and greedy outputs
     are token-identical to single-chip serving.  Per-mesh-config the compiled
-    decode-side program count is unchanged (<= 2).
+    decode-side program count is unchanged: the ONE fused step program
+    (<= 2 with `fuse=False`).
     """
 
     def __init__(self, params, config: gpt_mod.GPTConfig, *,
@@ -327,6 +374,8 @@ class LLMEngine:
                  spec_len: int = 0,
                  draft_proposer: Optional[DraftProposer] = None,
                  spec_backoff_window: int = 8,
+                 fuse: bool = True,
+                 double_buffer: Optional[bool] = None,
                  mesh=None, mp: Optional[int] = None,
                  seed: int = 0,
                  clock: Optional[Callable[[], float]] = None,
@@ -413,6 +462,15 @@ class LLMEngine:
             raise ValueError(
                 f"spec_backoff_window must be >= 0, got {spec_backoff_window}")
         self.spec_backoff_window = spec_backoff_window
+        # fused one-dispatch step (see module docstring): the program's token
+        # width covers the widest lane that can ride it — K+1 verify rows
+        # and, in chunked mode, the prefill chunk (choose prefill_chunk near
+        # spec_len+1 to minimize decode-row padding)
+        self.fused = bool(fuse)
+        self.double_buffer = self.fused and \
+            (True if double_buffer is None else bool(double_buffer))
+        self._fused_T = max(self.spec_len + 1,
+                            prefill_chunk if self.chunked else 1)
         self.cache = PagedKVCache(num_pages, page_size, num_slots,
                                   max_pages_per_slot)
         self._pool = gpt_mod.init_paged_cache(config, num_pages, page_size)
@@ -558,6 +616,19 @@ class LLMEngine:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), \
                 pin_pool(pool)
 
+        temp_, topk_ = temperature, top_k
+
+        def fused_impl(params, tokens, pool, table, q_offset, valid, key,
+                       greedy):
+            # THE one-dispatch step: decode/verify/chunk slots in one batch,
+            # sampling + accept scan on device, host-visible output O(B*K)
+            # ints (never [B, V] logits — guarded by the JXP005 jaxpr audit)
+            out, accept, pool, key = gpt_mod.serve_step_paged(
+                params, tokens, pool, table, q_offset, valid, cfg, key=key,
+                greedy=greedy, sample=sample, temperature=temp_, top_k=topk_,
+                mesh=mesh_)
+            return out, accept, pin_pool(pool), key
+
         def copy_impl(pool, src, dst):
             # COW page copy: one [page, KVH, hd] slab per layer, src -> dst
             # (page axis is unsharded, so the copy is collective-free under mp)
@@ -572,14 +643,34 @@ class LLMEngine:
             if self.mp > 1 \
             else (lambda fn, donate, skip=0:
                   jax.jit(fn, donate_argnums=donate))
-        self._decode_fn = jit_(decode_impl, (2,), 1)    # skip=1: params static
+        if self.fused:
+            # the fused program IS the decode-side executable; the legacy
+            # verify program is never built (decode-side count: exactly 1),
+            # and in chunked mode the chunk rides the fused batch so the
+            # standalone chunk program goes too.  Bucketed mode keeps the
+            # chunk program for prefix-hit tails (cold path, like the
+            # bucketed one-shot prefill).
+            self._decode_fn = jit_(fused_impl, (2,), 1)  # skip=1: params static
+            self._verify_fn = None
+            self._chunk_fn = None if self.chunked else jit_(chunk_impl, (2,), 1)
+        else:
+            self._decode_fn = jit_(decode_impl, (2,), 1)
+            self._verify_fn = jit_(verify_impl, (2,), 1)
+            self._chunk_fn = jit_(chunk_impl, (2,), 1)
         self._prefill_fn = jit_(prefill_impl, (2,), 1)
-        self._chunk_fn = jit_(chunk_impl, (2,), 1)
-        self._verify_fn = jit_(verify_impl, (2,), 1)
         self._copy_fn = jit_(copy_impl, (0,))
         self._seen_buckets = set()
         self._chunk_used = False
         self._copy_used = False
+        self._decode_used = False       # any decode-side dispatch happened
+        # double-buffer state: the un-synced result of the last fused
+        # dispatch (device arrays + the host metadata to interpret them) and
+        # finishes surfaced outside step() (an abort-time harvest)
+        self._inflight: Optional[Dict[str, object]] = None
+        self._orphan_finished: List[RequestOutput] = []
+        self._step_dispatches = 0
+        self._step_sync_s = 0.0
+        self._step_slots = {"decode": 0, "verify": 0, "chunk": 0}
         self.reset_counters()
 
     def reset_counters(self) -> None:
@@ -644,7 +735,14 @@ class LLMEngine:
         max_new_tokens runs out).  Shared prefix pages are only
         deref-counted; the request lands in the outputs map with
         finish_reason="abort" and whatever tokens it had produced.  Returns
-        False when the id is unknown or already finished."""
+        False when the id is unknown or already finished.
+
+        Under double-buffering the in-flight fused batch is harvested first,
+        so the abort sees exact bookkeeping (a request the pending tokens
+        just finished is reported as already done, not aborted); requests
+        that finish during this harvest surface from the NEXT step() call."""
+        if self._inflight is not None:
+            self._harvest(self._orphan_finished)
         for i, req in enumerate(self._queue):
             if req.request_id == request_id:
                 # del by index, NOT deque.remove: remove's equality scan would
@@ -728,33 +826,59 @@ class LLMEngine:
 
     # ---- scheduler --------------------------------------------------------
     def step(self) -> List[RequestOutput]:
-        """One engine iteration: admit queued requests into free slots
-        (prefix-cache matching + page reservation), advance at most ONE
-        prefill chunk, then one decode step over every fully-prefilled slot.
-        Returns the requests that finished this iteration.
+        """One engine iteration: harvest the previous fused dispatch (double-
+        buffered mode), admit queued requests into free slots (prefix-cache
+        matching + page reservation), stage at most ONE prefill chunk, then
+        dispatch decode work — ONE fused program covering every decode/
+        verify/chunk slot (default), or the legacy per-mode programs
+        (`fuse=False`).  Returns the requests that finished this iteration
+        (under double-buffering a request finishes the step its tokens are
+        harvested, one after its last dispatch).
 
-        Each iteration appends one record to the step-trace ring
+        Each iteration appends one v2 record to the step-trace ring
         (`step_trace()`): what the step dispatched (decode-batch occupancy,
-        chunk interleaved, verify dispatches, tokens emitted) and the page
-        pool it left behind — the timeline that answers "what was the engine
-        doing when this request was slow"."""
-        finished: List[RequestOutput] = []
+        per-mode slot counts, dispatch count, harvest-sync time, chunk
+        interleaved, verify dispatches, tokens emitted) and the page pool it
+        left behind — the timeline that answers "what was the engine doing
+        when this request was slow"."""
+        finished: List[RequestOutput] = self._orphan_finished
+        self._orphan_finished = []
         t0 = self._now()
         tok0 = self._decode_tokens.value
         ver0 = self._verify_steps.value
         chunk0 = self._prefill_chunks.value
+        self._step_dispatches = 0
+        self._step_sync_s = 0.0
+        self._step_slots = {"decode": 0, "verify": 0, "chunk": 0}
         with self._span("engine.step"):
+            self._harvest(finished)     # step n-1's tokens land first
             with self._span("engine.admit"):
                 self._admit(finished)
-            self._prefill_tick(finished)
-            decode_batch = len(self._running)
-            if self._running:
-                self._decode_iter(finished)
+            if self.fused:
+                if self.chunked:
+                    chunk_job = self._stage_chunk()
+                else:
+                    # bucketed mode: prefix-hit tails keep the standalone
+                    # chunk program (cold path, next to the one-shot prefill)
+                    self._prefill_tick(finished)
+                    chunk_job = None
+                decode_batch = len(self._running)
+                if self._running or chunk_job is not None:
+                    self._fused_iter(chunk_job, finished)
+            else:
+                self._prefill_tick(finished)
+                decode_batch = len(self._running)
+                if self._running:
+                    self._decode_iter(finished)
         dur = self._now() - t0
         self._h_step.observe(dur)
         self._step_idx += 1
         mgr = self.cache
         self._step_trace.append({
+            # v2 record (PR "one-dispatch step"): v1 keys unchanged, plus
+            # `v`/`fused`/`dispatches`/`sync_ms`/`slots` — consumers keyed on
+            # the v1 schema keep working, fusion-aware ones check `v`
+            "v": 2,
             "step": self._step_idx,
             "t": t0,
             "dur_s": dur,
@@ -769,6 +893,16 @@ class LLMEngine:
             "pages_in_use": mgr.pages_in_use(),
             "pages_free": mgr.num_free_pages,
             "pages_evictable": mgr.num_evictable_pages,
+            "fused": self.fused,
+            # decode-path dispatches this step (fused/decode/verify/chunk-
+            # interleave programs; the admission-time one-shot prefill is the
+            # cold path and is not counted)
+            "dispatches": self._step_dispatches,
+            # blocking device->host sync time spent inside this step's
+            # engine.sample.sync spans (harvest + legacy inline fetches)
+            "sync_ms": self._step_sync_s * 1e3,
+            # per-mode slot occupancy of this step's decode-path dispatches
+            "slots": dict(self._step_slots),
         })
         return finished
 
@@ -776,6 +910,172 @@ class LLMEngine:
         """The per-step timeline ring, oldest first (bounded at `trace_ring`
         records; cleared by `reset_counters()`)."""
         return list(self._step_trace)
+
+    # ---- fused one-dispatch step machinery --------------------------------
+    def _stage_chunk(self) -> Optional[Dict[str, object]]:
+        """Chunked+fused mode: pick the oldest mid-prefill slot's next chunk
+        and describe it for the fused batch (no standalone dispatch).  The
+        host bookkeeping that doesn't need the result — filled counter,
+        prefix registration — happens here; a chunk that completes its
+        prompt leaves `_prefilling` now and is resolved to a decode slot at
+        harvest, when its first token is known."""
+        if not self._prefilling:
+            return None
+        slot, st = next(iter(self._prefilling.items()))
+        lp = st.request.prompt.size
+        n = min(self._chunk, lp - st.filled)
+        job = {"slot": slot, "n": n, "q_offset": st.filled, "st": st,
+               "done": st.filled + n == lp}
+        st.filled += n
+        self._prefill_chunks.inc()
+        self._prefilled_tokens.inc(n)
+        if self.prefix_cache:
+            self.cache.register_prefix(slot, st.request.prompt, st.filled)
+        if job["done"]:
+            del self._prefilling[slot]      # resolved at harvest
+        return job
+
+    def _fused_iter(self, chunk_job: Optional[Dict[str, object]],
+                    finished: List[RequestOutput]) -> None:
+        """Build and dispatch the ONE fused program covering every active
+        lane this step: decode slots at valid=1, drafted (greedy) slots at
+        valid=1+len(draft), the staged prefill chunk at valid=chunk tokens.
+        Inactive/mid-prefill slots get null table rows.  The dispatch
+        returns un-synced; `_harvest` interprets the token/accept buffer —
+        immediately (double_buffer=False) or at the top of the next step."""
+        mgr = self.cache
+        B, T = mgr.num_slots, self._fused_T
+        if self._running:
+            self._decode_iters.inc()
+        if self.spec_len and self._running:
+            with self._span("engine.spec.propose"):
+                drafts = self._propose_drafts()
+        else:
+            drafts = {}
+        tokens = np.zeros((B, T), np.int32)
+        valid = np.ones((B,), np.int32)
+        qoff = np.zeros((B,), np.int32)
+        greedy = np.zeros((B,), bool)
+        table = mgr.page_table.copy()
+        slots: List[int] = []
+        nds: Dict[int, int] = {}
+        chunk_slot = chunk_job["slot"] if chunk_job is not None else None
+        for slot in range(B):
+            seq = self._running.get(slot)
+            if seq is not None:
+                slots.append(slot)
+                tokens[slot, 0] = seq.generated[-1]
+                qoff[slot] = mgr.lengths[slot]
+                greedy[slot] = seq.greedy
+                d = drafts.get(slot)
+                if d is not None:
+                    tokens[slot, 1:1 + d.size] = d
+                    valid[slot] = 1 + d.size
+                    nds[slot] = d.size
+            elif slot == chunk_slot:
+                st = chunk_job["st"]
+                n = chunk_job["n"]
+                q0 = chunk_job["q_offset"]
+                tokens[slot, :n] = st.request.prompt[q0:q0 + n]
+                valid[slot] = n
+                qoff[slot] = q0
+                greedy[slot] = self._req_greedy(st.request)
+            else:
+                table[slot, :] = 0          # inactive: KV to the null page
+        with self._span("engine.fused.dispatch"):
+            out, accept, self._pool, self._key = self._decode_fn(
+                self.params, self._h2d(tokens), self._pool,
+                self._h2d(table), self._h2d(qoff), self._h2d(valid),
+                self._key, self._h2d(greedy))
+        self._decode_used = True
+        self._step_dispatches += 1
+        self._step_slots["verify"] += len(nds)
+        self._step_slots["decode"] += len(slots) - len(nds)
+        self._step_slots["chunk"] += int(chunk_job is not None)
+        if nds:
+            # the fused dispatch carried >= 1 draft: it IS this step's verify
+            # dispatch (the counter keeps its "verify-program dispatches"
+            # meaning for timeline/bench consumers)
+            self._verify_steps.inc()
+        inflight = {"out": out, "accept": accept, "slots": slots,
+                    "drafts": {s: drafts[s] for s in nds},
+                    "chunk": chunk_job}
+        if self.double_buffer:
+            self._inflight = inflight
+        else:
+            self._harvest(finished, inflight)
+
+    def _harvest(self, finished: List[RequestOutput],
+                 inflight: Optional[Dict[str, object]] = None) -> None:
+        """Fetch and apply the result of a fused dispatch: the `[B, T] + [B]`
+        int token/accept buffer (the step's ONLY device->host transfer —
+        O(B*K) ints, not [B, V] logits).  Emits each running slot's accepted
+        prefix + bonus (or its single decode/sampled token), resolves a
+        completed chunk into the decode set, and retires finishers."""
+        inf = inflight if inflight is not None else self._inflight
+        if inflight is None:
+            self._inflight = None
+        if inf is None:
+            return
+        t_sync = self._now()
+        with self._span("engine.sample.sync"):
+            out = np.asarray(inf["out"])        # blocks on the device result
+            accept = np.asarray(inf["accept"])
+        self._step_sync_s += self._now() - t_sync
+        drafts = inf["drafts"]
+        with self._span("engine.spec.accept"):
+            for slot in inf["slots"]:
+                seq = self._running[slot]
+                d = drafts.get(slot)
+                nd = 0 if d is None else d.size
+                a = int(accept[slot])           # on-device prefix match, <= nd
+                # accepted drafts equal the predictions they matched, so the
+                # emitted run is out[:a] + the bonus token out[a]
+                emitted = [int(x) for x in out[slot, :a + 1]]
+                if self._emit_slot(seq, slot, emitted, nd, a, finished):
+                    del self._running[slot]
+            cj = inf["chunk"]
+            if cj is not None and cj["done"]:
+                st = cj["st"]
+                tok = int(out[cj["slot"], cj["n"] - 1])
+                self._start_decoding(st.request, cj["slot"], tok,
+                                     st.cached_tokens, finished)
+
+    def _emit_slot(self, seq: _Running, slot: int, emitted: List[int],
+                   nd: int, a: int, finished: List[RequestOutput]) -> bool:
+        """Apply one slot's decode/verify emission — budget-room truncation,
+        EOS cut, length advance (rejected candidate KV above it is stale
+        garbage inside the slot's own reservation), token/spec counters, the
+        zero-accept back-off streak — and retire the slot if it finished.
+        The ONE copy both the fused harvest and the legacy `_verify_iter` go
+        through, so their byte parity cannot drift.  Returns True when the
+        caller must drop the slot from the running set."""
+        room = seq.request.max_new_tokens - len(seq.generated)
+        emitted = emitted[:room]
+        if self.eos_token_id is not None and self.eos_token_id in emitted:
+            emitted = emitted[:emitted.index(self.eos_token_id) + 1]
+        self.cache.lengths[slot] += len(emitted)
+        seq.generated.extend(emitted)
+        self._decode_tokens.inc(len(emitted))
+        if nd:
+            self._spec_events.inc()
+            self._spec_drafted.inc(nd)
+            self._spec_accepted.inc(a)
+            self._spec_emitted.inc(len(emitted))
+            # adaptive spec back-off: a slot whose drafts are NEVER accepted
+            # (acceptance rate ~0 over the window) stops paying the proposer
+            # scan and the wasted candidate positions — it keeps riding the
+            # decode-side program at valid=1.  Output parity is untouched:
+            # greedy acceptance is lossless either way.
+            if a == 0:
+                seq.spec_zero_streak += 1
+                if self.spec_backoff_window and not seq.spec_off and \
+                        seq.spec_zero_streak >= self.spec_backoff_window:
+                    seq.spec_off = True
+                    self._spec_backoffs.inc()
+            else:
+                seq.spec_zero_streak = 0
+        return self._maybe_finish(seq, finished)
 
     def _admit(self, finished: List[RequestOutput]) -> None:
         mgr = self.cache
@@ -835,17 +1135,22 @@ class LLMEngine:
                 self._prefilled_tokens.inc(lp)
                 if self.prefix_cache:
                     mgr.register_prefix(slot, req.prompt, lp)
+                t_sync = self._now()
                 with self._span("engine.sample.sync"):
                     first = int(np.asarray(first)[0])   # blocks on the result
+                self._step_sync_s += self._now() - t_sync
                 self._start_decoding(req, slot, first, 0, finished)
             else:
                 self._prefilling[slot] = _Prefilling(req, slot, matched,
                                                      matched)
 
     def _prefill_tick(self, finished: List[RequestOutput]) -> None:
-        """Advance the oldest admitted prompt by ONE chunk (the Sarathi
-        interleave cap: long prompts share each iteration with decode instead
-        of stalling it)."""
+        """Advance the oldest admitted prompt by ONE chunk through the
+        standalone chunk program (the Sarathi interleave cap: long prompts
+        share each iteration with decode instead of stalling it).  Legacy
+        `fuse=False` path, plus prefix-hit tails in fused bucketed mode; in
+        fused chunked mode the chunk rides the fused batch instead
+        (`_stage_chunk`)."""
         if not self._prefilling:
             return
         slot, st = next(iter(self._prefilling.items()))
@@ -863,6 +1168,8 @@ class LLMEngine:
                 self._h2d([n], np.int32),
                 self._key, self._h2d([self._req_greedy(st.request)]))
         self._chunk_used = True
+        self._step_dispatches += 1
+        self._step_slots["chunk"] += 1
         self._prefill_chunks.inc()
         self._prefilled_tokens.inc(n)
         st.filled += n
@@ -870,8 +1177,10 @@ class LLMEngine:
             mgr.register_prefix(slot, st.request.prompt, st.filled)
         if st.filled == lp:
             del self._prefilling[slot]
+            t_sync = self._now()
             with self._span("engine.sample.sync"):
                 tok = int(np.asarray(tok)[0])           # blocks on the result
+            self._step_sync_s += self._now() - t_sync
             self._start_decoding(st.request, slot, tok, st.cached_tokens,
                                  finished)
 
@@ -978,8 +1287,13 @@ class LLMEngine:
             preds, self._pool = self._verify_fn(
                 self.params, self._h2d(tokens), self._pool,
                 self._h2d(table), self._h2d(qoff), self._h2d(valid))
+        self._decode_used = True
+        self._step_dispatches += 1
+        self._step_slots["verify"] += len(active)
+        t_sync = self._now()
         with self._span("engine.sample.sync"):
             preds = np.asarray(preds)       # blocks on the device result
+        self._step_sync_s += self._now() - t_sync
         self._verify_steps.inc()
         with self._span("engine.spec.accept"):
             for slot in active:
@@ -991,35 +1305,7 @@ class LLMEngine:
                     a += 1          # greedy longest-prefix acceptance
                 emitted = [int(x) for x in d[:a]] if nd else []
                 emitted.append(int(preds[slot, a]))        # bonus token
-                room = seq.request.max_new_tokens - len(seq.generated)
-                emitted = emitted[:room]
-                if self.eos_token_id is not None and \
-                        self.eos_token_id in emitted:
-                    emitted = emitted[:emitted.index(self.eos_token_id) + 1]
-                mgr.lengths[slot] += len(emitted)          # rejected KV: stale
-                seq.generated.extend(emitted)
-                self._decode_tokens.inc(len(emitted))
-                if nd:
-                    self._spec_events.inc()
-                    self._spec_drafted.inc(nd)
-                    self._spec_accepted.inc(a)
-                    self._spec_emitted.inc(len(emitted))
-                    # adaptive spec back-off: a slot whose drafts are NEVER
-                    # accepted (acceptance rate ~0 over the window) stops
-                    # paying the proposer scan and the wasted candidate
-                    # positions — it keeps riding the verify program at
-                    # valid=1.  Output parity is untouched: greedy acceptance
-                    # is lossless either way.
-                    if a == 0:
-                        seq.spec_zero_streak += 1
-                        if self.spec_backoff_window and not seq.spec_off and \
-                                seq.spec_zero_streak >= \
-                                self.spec_backoff_window:
-                            seq.spec_off = True
-                            self._spec_backoffs.inc()
-                    else:
-                        seq.spec_zero_streak = 0
-                if self._maybe_finish(seq, finished):
+                if self._emit_slot(seq, slot, emitted, nd, a, finished):
                     del self._running[slot]
 
     def _vanilla_decode_iter(self, slots: List[int],
@@ -1049,9 +1335,14 @@ class LLMEngine:
                 self.params, self._h2d(tokens), self._pool,
                 self._h2d(table), self._h2d(mgr.lengths), self._key,
                 self._h2d(greedy))
+        self._decode_used = True
+        self._step_dispatches += 1
+        self._step_slots["decode"] += len(active)
         self._decode_tokens.inc(len(active))
+        t_sync = self._now()
         with self._span("engine.sample.sync"):
             nxt = np.asarray(nxt)           # blocks on the device result
+        self._step_sync_s += self._now() - t_sync
         for slot in slots:
             seq = self._running[slot]
             mgr.lengths[slot] += 1          # the token we just fed is cached
@@ -1062,8 +1353,12 @@ class LLMEngine:
     def warm_spec(self) -> None:
         """Compile the verify executable against inert inputs (all slots
         masked to the null page) — benches call this during warmup so the
-        one-off compile stays out of timed counters."""
-        if not self.spec_len:
+        one-off compile stays out of timed counters.  Fused engines have no
+        standalone verify program (`warm_decode` already compiled the one
+        fused executable every lane rides), so this is a no-op there — which
+        also keeps the PRNG stream of a sampled spec-on pass aligned with
+        its spec-off comparison pass."""
+        if not self.spec_len or self._verify_fn is None:
             return
         B, T = self.cache.num_slots, self.spec_len + 1
         _, self._pool = self._verify_fn(
@@ -1073,17 +1368,28 @@ class LLMEngine:
             self._h2d(np.ones((B,), np.int32)))
 
     def warm_decode(self) -> None:
-        """Compile the vanilla decode executable against inert inputs — a
-        1-token warmup request picks its only token at prefill and retires
-        without ever decoding, so benches warm the decode program explicitly.
-        On a sampling engine this advances the PRNG stream by one split, like
-        any real decode dispatch would."""
+        """Compile the decode-side executable against inert inputs (all
+        slots masked to the null page) — a 1-token warmup request picks its
+        only token at prefill and retires without ever decoding, so benches
+        warm the decode program explicitly.  In fused mode this compiles THE
+        one fused program (decode/verify/chunk share its fixed shape).  On a
+        sampling engine this advances the PRNG stream by one split, like any
+        real decode dispatch would."""
         B = self.cache.num_slots
-        _, self._pool, self._key = self._decode_fn(
-            self.params, self._h2d(np.zeros((B,), np.int32)), self._pool,
-            self._h2d(np.zeros((B, self.cache.max_pages_per_slot), np.int32)),
-            self._h2d(np.zeros((B,), np.int32)), self._key,
-            self._h2d(np.zeros((B,), bool)))
+        tbl = np.zeros((B, self.cache.max_pages_per_slot), np.int32)
+        if self.fused:
+            _, _, self._pool, self._key = self._decode_fn(
+                self.params, self._h2d(np.zeros((B, self._fused_T), np.int32)),
+                self._pool, self._h2d(tbl),
+                self._h2d(np.zeros((B,), np.int32)),
+                self._h2d(np.ones((B,), np.int32)), self._key,
+                self._h2d(np.zeros((B,), bool)))
+        else:
+            _, self._pool, self._key = self._decode_fn(
+                self.params, self._h2d(np.zeros((B,), np.int32)), self._pool,
+                self._h2d(tbl), self._h2d(np.zeros((B,), np.int32)),
+                self._key, self._h2d(np.zeros((B,), bool)))
+        self._decode_used = True
 
     def _maybe_finish(self, seq: _Running,
                       finished: List[RequestOutput]) -> bool:
@@ -1111,7 +1417,8 @@ class LLMEngine:
 
     @property
     def has_work(self) -> bool:
-        return bool(self._queue or self._running or self._prefilling)
+        return bool(self._queue or self._running or self._prefilling or
+                    self._inflight is not None or self._orphan_finished)
 
     # ---- observability ----------------------------------------------------
     @contextlib.contextmanager
@@ -1172,15 +1479,19 @@ class LLMEngine:
         cached = self._prefix_cached_tokens.value
         computed = self._prefilled_tokens.value
         spec_events = self._spec_events.value
+        # fused mode: _decode_fn IS the one fused program (decode-side count
+        # 1); the standalone verify/chunk programs are never built (None)
         return {
             "decode_executables": execs(self._decode_fn,
-                                        1 if self._decode_iters.value else 0),
-            "verify_executables": execs(self._verify_fn,
+                                        1 if self._decode_used else 0),
+            "verify_executables": 0 if self._verify_fn is None else
+                                  execs(self._verify_fn,
                                         1 if self._verify_steps.value else 0),
             "prefill_executables": execs(self._prefill_fn,
                                          len(self._seen_buckets)) +
-                                   execs(self._chunk_fn,
-                                         1 if self._chunk_used else 0),
+                                   (0 if self._chunk_fn is None else
+                                    execs(self._chunk_fn,
+                                          1 if self._chunk_used else 0)),
             "copy_executables": execs(self._copy_fn,
                                       1 if self._copy_used else 0),
             "buckets": list(self.buckets),

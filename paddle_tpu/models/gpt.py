@@ -995,6 +995,70 @@ def verify_step_paged(params, tokens, cache, page_table, lengths, valid,
     return jnp.matmul(x, head_matrix(params, config)), cache
 
 
+def serve_step_paged(params, tokens, cache, page_table, q_offset, valid,
+                     config: GPTConfig, key=None, greedy=None, *,
+                     sample: bool = False, temperature=1.0, top_k=None,
+                     mesh=None):
+    """The fused serving step: decode, spec-verify and an interleaved prefill
+    chunk ride ONE fixed-shape executable, and sampling + greedy acceptance
+    run on device — the host fetches a small `[B, T] + [B]` int token/accept
+    buffer instead of `[B, V]` logits (the reference's single-graph
+    `AnalysisPredictor::ZeroCopyRun` step, Sarathi-style piggybacking).
+
+    Per-slot contract (mode is implied by the scheduler's inputs, not a
+    device lane):
+    - decode slot:  tokens[b, 0] = last emitted token, valid[b] = 1,
+      q_offset[b] = tokens already cached;
+    - verify slot:  tokens[b, 1:1+K] = drafted continuation, valid[b] = 1+K
+      (`verify_step_paged` semantics — rejected KV rolls back as a length
+      decrement on the host);
+    - chunk slot:   tokens[b, :n] = the next prompt chunk, valid[b] = n,
+      q_offset[b] = prompt tokens already in pages (`prefill_chunk_paged`
+      semantics — only the final chunk's pick is consumed);
+    - inactive:     null page-table row, valid[b] = 1 (garbage the scheduler
+      ignores).
+
+    Returns (out_tokens [B, T] int32, accept [B] int32, cache, key):
+    `out_tokens[b, t]` is the greedy prediction after position t, except
+    position valid-1 where sampled (greedy[b]=False) slots carry the
+    temperature/top-k pick instead — so a decode slot's token is
+    `out[b, 0]`, a finished chunk's first token is `out[b, valid-1]`, and a
+    verify slot emits `out[b, :accept[b]+1]` (accepted drafted prefix, which
+    equals the predictions it matched, plus the bonus token).  `accept[b]` is
+    the on-device greedy longest-prefix match length over the drafted tokens
+    (0 for undrafted slots).  `key` advances by one split iff `sample`.
+    """
+    from ..incubate.kernels.paged_attention import paged_serve_attention
+    x, cache = _paged_chunk_hidden(params, tokens, config, cache,
+                                   page_table, q_offset, valid,
+                                   attn_entry=paged_serve_attention,
+                                   mesh=mesh)
+    x = epilogue(params, x, config)
+    logits = jnp.matmul(x, head_matrix(params, config))       # [B, T, V]
+    out = jnp.argmax(logits, axis=-1).astype(jnp.int32)       # [B, T]
+    B, T = tokens.shape
+    rows = jnp.arange(B)
+    if sample:
+        # one batched pick at each slot's last real position, through the ONE
+        # shared sampling implementation (`sample_token` split-key
+        # discipline); the greedy mask routes temperature=0.0 requests to the
+        # argmax already in `out`, so their tokens stay PRNG-independent
+        ids, key = sample_token(logits[rows, valid - 1], key, sample=True,
+                                temperature=temperature, top_k=top_k)
+        pick = jnp.where(greedy, out[rows, valid - 1], ids)
+        out = out.at[rows, valid - 1].set(pick)
+    # greedy longest-prefix acceptance, on device: drafted token t+1 is
+    # accepted iff it equals the prediction after position t and every
+    # earlier draft was accepted (cumprod); positions past the draft
+    # (t >= valid-1) never match.  Sampled slots carry no draft (valid=1),
+    # so the fold at valid-1 above cannot perturb the scan.
+    match = (tokens[:, 1:] == out[:, :-1]) & \
+        (jnp.arange(T - 1)[None, :] < (valid - 1)[:, None])
+    accept = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                     axis=1).astype(jnp.int32)
+    return out, accept, cache, key
+
+
 # LRU-bounded executable cache for `generate` (unbounded it leaks one compiled
 # program per (config, B, Tp, max_new, sampling) combination — a real leak
 # under varied prompt shapes; the serving engine bounds shapes by bucketing

@@ -186,8 +186,11 @@ def test_request_lifecycle_fake_clock(tiny):
     per-request record and the engine histograms."""
     cfg, params = tiny
     clk = FakeClock(10.0)
+    # double_buffer=False: this test pins exact per-step stamp math, which
+    # needs tokens observed in the step that dispatched them (the deferred-
+    # harvest ordering has its own test in test_fused_step.py)
     eng = LLMEngine(params, cfg, num_slots=2, page_size=8, max_model_len=64,
-                    clock=clk)
+                    clock=clk, double_buffer=False)
     rid = eng.add_request(np.arange(5, dtype=np.int32), max_new_tokens=3)
     clk.t = 12.0
     # one step() = admit + bucketed prefill (first token) + a decode
@@ -305,9 +308,11 @@ def test_chrome_trace_and_step_timeline(spec_eng, tmp_path):
         eng.run()
     host = json.loads((td / "host_trace.json").read_text())
     names = {e["name"] for e in host["traceEvents"]}
-    assert {"engine.step", "engine.admit", "engine.prefill.dispatch",
-            "engine.spec.propose", "engine.verify.dispatch",
-            "engine.spec.accept", "engine.sample.sync"} <= names
+    # fused engine (default): the one-dispatch step emits the fused span in
+    # place of the legacy verify/decode/chunk dispatch spans
+    assert {"engine.step", "engine.admit", "engine.fused.dispatch",
+            "engine.spec.propose", "engine.spec.accept",
+            "engine.sample.sync"} <= names
     assert names <= set(ENGINE_SPANS)
     for e in host["traceEvents"]:
         assert e["ph"] == "X" and e["dur"] >= 0
@@ -315,7 +320,8 @@ def test_chrome_trace_and_step_timeline(spec_eng, tmp_path):
     assert timeline and timeline[-1]["step"] >= len(timeline)
     for key in ("decode_batch", "chunk", "verify_dispatches",
                 "tokens_emitted", "pages_in_use", "pages_free",
-                "pages_evictable", "queued", "running", "prefilling"):
+                "pages_evictable", "queued", "running", "prefilling",
+                "v", "fused", "dispatches", "sync_ms", "slots"):
         assert key in timeline[-1]
     assert any(r["tokens_emitted"] > 0 for r in timeline)
     snap = json.loads((td / "metrics.json").read_text())

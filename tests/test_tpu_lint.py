@@ -436,10 +436,49 @@ def test_jxp004_sharding_constraint_required_under_mp():
     assert any(f.rule == "JXP004" for f in fs)
 
 
+def test_jxp005_oversized_host_output():
+    """JXP005 pos/neg pair: a program returning [B, V] float logits (or any
+    output blob over the int budget) is flagged; a token/accept-sized int
+    output with the donated pool riding through passes."""
+    B, V = 4, 256
+    pool = {"k": jnp.zeros((8, 64), jnp.float32)}
+    args = (pool, jnp.zeros((B, 5), jnp.int32))
+
+    def bad_body(pool, tokens):
+        logits = jnp.ones((B, V), jnp.float32) * tokens[:, :1]
+        return logits, {k: v + 1 for k, v in pool.items()}
+
+    fs = audit_jaxpr("bad", jax.jit(bad_body, donate_argnums=(0,)), args,
+                     donate_paths=("arg0",), host_output_budget=B * 8)
+    assert any(f.rule == "JXP005" and "logits" in f.message for f in fs)
+    assert any(f.rule == "JXP005" and "budget" in f.message for f in fs)
+
+    def bf16_body(pool, tokens):
+        # bf16 logprobs SMALL enough to fit the element budget: the
+        # float-matrix check alone must catch it (TPU serving dtype)
+        lp = jnp.ones((B, 5), jnp.bfloat16) * tokens[:, :1].astype(jnp.bfloat16)
+        return lp, {k: v + 1 for k, v in pool.items()}
+
+    fs = audit_jaxpr("bad16", jax.jit(bf16_body, donate_argnums=(0,)), args,
+                     donate_paths=("arg0",), host_output_budget=B * 8)
+    assert any(f.rule == "JXP005" and "logits" in f.message for f in fs)
+
+    def good_body(pool, tokens):
+        preds = jnp.argmax(jnp.ones((B, 5, V)) * tokens[..., None], -1)
+        return preds.astype(jnp.int32), jnp.zeros((B,), jnp.int32), \
+            {k: v + 1 for k, v in pool.items()}
+
+    assert audit_jaxpr("good", jax.jit(good_body, donate_argnums=(0,)), args,
+                       donate_paths=("arg0",),
+                       host_output_budget=B * 8) == []
+
+
 def test_serving_executables_jaxpr_clean():
-    """Level 2 over the REAL serving set (decode/chunk/bucketed-prefill/
-    verify/copy, mp1 + mp2): donation declared == donation traced, no
-    embedded transfers, no f64, mp outputs pinned."""
+    """Level 2 over the REAL serving set (the fused one-dispatch step with
+    its O(B*K)-int host-output budget, plus the --no-fuse decode/chunk/
+    bucketed-prefill/verify trio and the COW copy, mp1 + mp2): donation
+    declared == donation traced, no embedded transfers, no f64, mp outputs
+    pinned, no logits-shaped host output."""
     assert run_jaxpr_checks(include_mp=True) == []
 
 

@@ -17,9 +17,10 @@ or make a "counter" go backwards:
 - **monotonicity** — across a CPU-smoke engine loop that exercises admission,
   chunked prefill, speculative verify, prefix hits, LRU eviction AND abort,
   no counter ever decreases between steps;
-- **program budget** — decode-side compiled programs <= 2 with metrics
-  enabled (observability is host-only; see tools/check_program_count.py for
-  the full per-mesh budget).
+- **program budget** — decode-side compiled programs within the budget
+  declared in paddle_tpu/analysis/registry.py with metrics enabled
+  (observability is host-only; see tools/check_program_count.py for the
+  full per-mesh budget).
 
 Exits non-zero with a diff on violation.  Usage:
     JAX_PLATFORMS=cpu python tools/check_metrics.py
@@ -192,10 +193,13 @@ def main() -> int:
     check_exposition(eng.metrics.to_prometheus(), errors)
 
     # observability must be free of compiled programs: decode-side budget
-    # unchanged (the full per-mesh budget lives in check_program_count.py)
+    # unchanged — the bound comes from the registry (declared ONCE) so this
+    # guard cannot drift from check_program_count's
+    from paddle_tpu.analysis.registry import SERVE_PROGRAM_BUDGET
+    bound = SERVE_PROGRAM_BUDGET["decode_side_executables"]
     decode_side = st["decode_executables"] + st["verify_executables"]
-    if decode_side > 2:
-        errors.append(f"decode-side executables {decode_side} > 2 with "
+    if decode_side > bound:
+        errors.append(f"decode-side executables {decode_side} > {bound} with "
                       f"metrics enabled — instrumentation leaked into a "
                       f"compiled program")
 

@@ -6,19 +6,21 @@ of executables regardless of traffic shape (README "Serving" section).  The
 documented budget, which this script re-measures on every run so a future PR
 cannot silently reintroduce per-shape recompiles:
 
-- decode-side: <= 2 programs (vanilla `decode_step_paged` + the spec-decode
-  `verify_step_paged`) — one token or spec_len+1 tokens per slot per step,
-  nothing else;
-- prefill-side (chunked mode): <= 2 programs (the q_offset chunk executable;
-  the bucketed ladder is off);
+- decode-side: <= 1 program — THE fused `serve_step_paged` executable
+  (vanilla decode, spec verify and the interleaved prefill chunk all ride
+  one fixed-shape batch, sampling + acceptance on device);
+- prefill-side (chunked mode): <= 2 programs for the cold paths (the chunk
+  rides the fused batch, so a chunked fused run measures 0);
 - copy: <= 1 program (the COW page copy);
-- total: <= 5.
+- total: <= 4.
 
 The budget holds PER MESH CONFIG: a second pass re-measures under mp=2
 tensor-parallel serving (8 forced CPU host devices — the same simulation the
-multichip training dryrun uses) and asserts decode-side <= 2 and total <= 6.
-The mp engine AOT-compiles its executables, so the measured counts are exact
-distinct-program counts, not dispatch-cache sizes.
+multichip training dryrun uses) and asserts decode-side <= 1 there too.  The
+mp engine AOT-compiles its executables, so the measured counts are exact
+distinct-program counts, not dispatch-cache sizes.  (`--no-fuse` serving is
+the A/B escape hatch and sits outside this budget — it is still audited by
+tpu_lint's jaxpr level.)
 
 Runs the bench_serve CPU smoke (chunked prefill + prefix cache + speculative
 decoding — every lane the scheduler can dispatch) and exits non-zero with a

@@ -10,9 +10,12 @@ layer of custom static checks — op-registry audits, API guards):
   Python branch on a traced value, TPL005 untimed blocking device fetch,
   TPL006 broad except around device code, LINT000 suppression without a
   reason.  Suppress per line with `# tpu-lint: disable=TPL001 -- reason`.
-- **jaxpr** (`analysis/jaxpr_checks.py`): traces the serving executables and
+- **jaxpr** (`analysis/jaxpr_checks.py`): traces the serving executables
+  (the fused one-dispatch step AND the --no-fuse legacy trio, mp1+mp2) and
   audits the programs — JXP001 embedded transfers, JXP002 donation
-  mismatches, JXP003 f64 upcasts, JXP004 missing mp sharding constraints.
+  mismatches, JXP003 f64 upcasts, JXP004 missing mp sharding constraints,
+  JXP005 oversized host-visible output (the fused step must return O(B*K)
+  ints, never [B, V] logits).
 
 Exit status is non-zero on any unsuppressed finding.
 
