@@ -37,7 +37,11 @@ step, and the default run replays the same stream unfused to report
 `fused_speedup` and byte-exact `fuse_parity`.  The JSON carries
 `dispatches_per_step` (decode-path program dispatches per dispatching step —
 1.0 fused) and `host_sync_ms_per_step` (blocking d2h sync time) straight from
-the step timeline.
+the step timeline, plus the static roofline's `predicted_step_ms` for the
+decode-side program at this engine's shapes (`analysis/cost_model.py`:
+analytic flops vs compulsory HBM bytes over nameplate device specs) next to
+`measured_step_ms`, with `model_error` = measured/predicted — meaningful on
+TPU where the dispatch is device-bound, sanity-bounded only on the CPU smoke.
 
 `--mp N` serves tensor-parallel over N chips: Megatron-sharded serving params
 (qkv/fc1 column-, proj/fc2 row-split), page pool head-sharded, paged
@@ -105,6 +109,7 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
                     mp=mp if mp and mp > 1 else None,
                     trace_ring=4096)    # ring must hold the whole timed run
                                         # for the dispatches/sync aggregates
+    prefill_chunk = eng.prefill_chunk   # "auto" resolved by the engine
     rng = np.random.RandomState(seed)
     max_prompt = max_model_len - max_new_tokens
     shared = None
@@ -220,11 +225,27 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
                            if busy else 0.0)
     host_sync_ms = (sum(r["sync_ms"] for r in timeline) / len(busy)
                     if busy else 0.0)
+    # static roofline prediction for the decode-side program at THIS
+    # engine's shapes (`analysis/cost_model.py`): traced abstractly after
+    # the timed section — no dispatch, no compile, program counts untouched.
+    # model_error = measured/predicted; on TPU the dispatch is device-bound
+    # and the ratio is meaningful, on the CPU smoke host scheduling
+    # dominates and it is only sanity-bounded.
+    from paddle_tpu.analysis.cost_model import device_spec, engine_step_cost
+    dspec = device_spec()
+    predicted_ms = engine_step_cost(eng).predicted_ms(dspec, mp=eng.mp)
+    measured_ms = (sum(r["dur_s"] for r in busy) / len(busy) * 1e3
+                   if busy else 0.0)
     return {
         "mp": eng.mp,
         "fused": eng.fused,
         "dispatches_per_step": round(dispatches_per_step, 3),
         "host_sync_ms_per_step": round(host_sync_ms, 4),
+        "predicted_step_ms": round(predicted_ms, 4),
+        "measured_step_ms": round(measured_ms, 4),
+        "model_error": round(measured_ms / predicted_ms, 3)
+                       if predicted_ms > 0 else None,
+        "device_spec": dspec.name,
         "decode_tokens_per_sec_per_chip": round(decode_tokens / dt / n_chips, 1),
         "generated_tokens_per_sec": round(num_requests * max_new_tokens / dt, 1),
         "requests": num_requests,
@@ -279,9 +300,12 @@ def main():
                          "--xla_force_host_platform_device_count=8)")
     ap.add_argument("--shared-prefix-frac", type=float, default=0.0,
                     help="fraction of requests sharing a common prompt prefix")
-    ap.add_argument("--prefill-chunk", type=int, default=None,
+    ap.add_argument("--prefill-chunk", type=str, default=None,
                     help="Sarathi chunked prefill with this chunk length "
-                         "(default: bucketed one-shot prefill)")
+                         "(default: bucketed one-shot prefill); 'auto' lets "
+                         "the engine pick spec_len+1 (one page when spec is "
+                         "off) so the chunk lane never widens the fused "
+                         "program past what verify already needs")
     ap.add_argument("--no-prefix-cache", action="store_true",
                     help="disable copy-on-write prefix page sharing")
     ap.add_argument("--no-fuse", action="store_true",
@@ -310,6 +334,11 @@ def main():
         ap.error("--spec-len must be >= 0")
     if args.mp < 1:
         ap.error("--mp must be >= 1")
+    if args.prefill_chunk is not None and args.prefill_chunk != "auto":
+        try:
+            args.prefill_chunk = int(args.prefill_chunk)
+        except ValueError:
+            ap.error("--prefill-chunk must be an integer or 'auto'")
     spec_len = 0 if args.no_spec else args.spec_len
     if args.mp > 1:
         # make the CPU host expose enough virtual chips BEFORE jax initializes

@@ -14,6 +14,12 @@ Two levels, one CLI (`tools/tpu_lint.py`):
   serving executables with abstract inputs and audits the closed jaxprs for
   transfer primitives, donation mismatches, dtype upcasts and (mp) missing
   sharding constraints.
+- **Resource accounting** — `cost_model.py` (CLI `tools/tpu_cost.py`):
+  static HBM/collective/roofline accounts over the same serving executables
+  — at-rest sharded/replicated/pool bytes per device (JXP006 replicated
+  ceiling), donation-aware jaxpr-liveness peak (JXP008), collective
+  bytes/step from the optimized HLO (JXP007), and a bytes/flops roofline —
+  against `registry.SERVE_RESOURCE_BUDGET`.
 """
 from __future__ import annotations
 
@@ -24,11 +30,18 @@ from . import registry
 
 __all__ = ["AST_RULES", "Finding", "Rule", "Suppressions", "rule_table",
            "FileContext", "ModuleIndex", "iter_python_files",
-           "run_ast_checks", "registry", "run_jaxpr_checks"]
+           "run_ast_checks", "registry", "run_jaxpr_checks",
+           "run_cost_checks"]
 
 
 def run_jaxpr_checks(*args, **kwargs):
     """Lazy facade over `jaxpr_checks.run_jaxpr_checks` — level 2 imports
     jax; level 1 must stay importable without it."""
     from .jaxpr_checks import run_jaxpr_checks as impl
+    return impl(*args, **kwargs)
+
+
+def run_cost_checks(*args, **kwargs):
+    """Lazy facade over `cost_model.run_cost_checks` (imports jax)."""
+    from .cost_model import run_cost_checks as impl
     return impl(*args, **kwargs)

@@ -253,18 +253,24 @@ def _build_engine(mp: int, fuse: bool = True):
                      mp=mp if mp > 1 else None), cfg
 
 
-def serving_targets(mp: int = 1) -> List[Tuple[str, object, tuple, dict]]:
+def serving_targets(mp: int = 1, engines=None
+                    ) -> List[Tuple[str, object, tuple, dict]]:
     """(name, jitted fn, example args, audit kwargs) for every serving
     executable, mirroring the engine's own dispatch shapes.  Two engines:
     the default FUSED engine supplies the one-dispatch step (audited under
     JXP001-005 — the host-output budget proves the O(B*K)-int fetch), the
     bucketed cold prefill and the COW copy; a `fuse=False` engine supplies
     the legacy decode/chunk/verify trio so the --no-fuse escape hatch stays
-    under the same donation/transfer/dtype discipline."""
+    under the same donation/transfer/dtype discipline.  `engines` injects a
+    prebuilt (fused, legacy) pair so callers that also need the engine for
+    other accounts (tpu_cost's at-rest pass) build it once."""
     import jax.numpy as jnp
 
-    eng, _cfg = _build_engine(mp)
-    leg, _ = _build_engine(mp, fuse=False)
+    if engines is not None:
+        eng, leg = engines
+    else:
+        eng, _cfg = _build_engine(mp)
+        leg, _ = _build_engine(mp, fuse=False)
     B = eng.cache.num_slots
     P = eng.cache.max_pages_per_slot
     i32 = jnp.int32

@@ -12,6 +12,10 @@ Three consumers keep each other honest:
   ONCE here, so the runtime guard and the static guard cannot drift apart.
 - **`analysis/jaxpr_checks.py`**: level-2 targets reference the serving
   entries' budget buckets when auditing donation/transfer/dtype discipline.
+- **`tools/tpu_cost.py`**: re-measures the serving executables' static
+  resource account (at-rest HBM, liveness peak, collective bytes/step)
+  against `SERVE_RESOURCE_BUDGET` below — memory and communication budgets
+  are declared ONCE here, next to the program-count budget they extend.
 
 Granularity is (repo-relative path, enclosing function qualname): one entry
 covers every jit call textually inside that function (lambdas fold into their
@@ -51,6 +55,54 @@ SERVE_PROGRAM_BUDGET_MP: Dict[str, int] = {
     "prefill_executables": 2,
     "copy_executables": 1,
     "total_executables": 4,
+}
+
+# ---------------------------------------------------------------------------
+# serving resource budget (consumed by tools/tpu_cost.py --ci and tests)
+# ---------------------------------------------------------------------------
+
+# Static HBM/collective ceilings over the SAME tiny audit engines the jaxpr
+# checks trace (`jaxpr_checks._build_engine`: gpt_tiny(64), 2 slots, page 8,
+# chunk 8, spec 2 — mp1 AND mp2).  Units are cost-model bytes (traced aval
+# bytes, `analysis/cost_model.py` — deterministic across backends, no XLA
+# padding).  These are the repo's memory yardstick: the quantized-KV arc
+# must shrink the pool term, the vocab-sharded-head arc must move `wte` out
+# of the replicated set — both show up HERE before any TPU run.
+SERVE_RESOURCE_BUDGET: Dict[str, object] = {
+    # Per-buffer ceiling on bytes REPLICATED on every chip under mp (JXP006).
+    # The audit config's one big replicated buffer is the tied embedding/head
+    # `wte` (256 x 64 fp32 = 64 KiB); 2x covers it while still flagging any
+    # new replicated matrix of comparable size.  This ceiling names the
+    # 70B blocker: at GPT-3 vocab a replicated wte is 50304 x D x 2 bytes
+    # PER CHIP no matter how large the mesh — sharding it is ROADMAP item 5c.
+    "replicated_bytes_ceiling": 131072,
+    # Per-executable modeled peak HBM (JXP008): argument bytes + the
+    # donation-aware liveness watermark.  Measured 2026-08 at mp1/mp2
+    # (fused 689k/762k, decode 676k/750k, chunk 633k/710k, bucketed
+    # 607k/681k, verify 680k/753k, cow 82k/152k) + ~25% headroom for jax
+    # tracing drift; a real regression (an undonated pool copy, a second
+    # materialized logits buffer) blows through 25% immediately.
+    "peak_hbm_bytes": {
+        "fused_step": 950_000,
+        "decode": 940_000,
+        "chunk_prefill": 890_000,
+        "bucketed_prefill": 850_000,
+        "verify": 940_000,
+        "cow_copy": 190_000,
+    },
+    # Per-executable collective bytes per step (JXP007), keyed by the FULL
+    # target name: only the mp2 programs may communicate at all (Megatron
+    # row-parallel all-reduces, 2/layer, plus the head-sharded attention's
+    # resharding permutes — measured fused 32768 B/step at L=2).  An mp1
+    # program with ANY collective, or an mp2 program absent from this table,
+    # is undeclared traffic and fails CI.
+    "collective_bytes_per_step": {
+        "serve.mp2.fused_step": 49_152,
+        "serve.mp2.decode": 8_192,
+        "serve.mp2.chunk_prefill": 24_576,
+        "serve.mp2.bucketed_prefill": 24_576,
+        "serve.mp2.verify": 20_480,
+    },
 }
 
 

@@ -298,6 +298,33 @@ class BareExceptDeviceRule(Rule):
                 f"exceptions the fallback is for")
 
 
+class DoubleBufferHazardRule(Rule):
+    """TPL007: page-state mutation before harvesting the in-flight batch.
+
+    Under double-buffered scheduling (`fuse=True` + `double_buffer=True`)
+    the fused dispatch of step *n* is still writing KV when the host runs
+    between steps — its result is parked in `self._inflight` until the next
+    harvest.  A public entry point that frees or reassigns page-table/
+    refcount state (release/allocate, `lengths[...]`/`page_table[...]`
+    stores) while that batch is in flight hands pages to a new owner whose
+    bookkeeping the in-flight result will then corrupt — the invariant
+    `LLMEngine.abort()` protects by harvesting FIRST.  The rule keys on the
+    class publishing `_inflight` and on a `_harvest` call (directly or via a
+    callee) preceding the first mutation."""
+    code = "TPL007"
+    title = "double-buffer-hazard"
+    rationale = "page mutation with a dispatch in flight corrupts harvests"
+
+    def check(self, ctx):
+        for hz in ctx.db_hazards:
+            yield self.finding(
+                ctx, hz.node,
+                f"public `{hz.method}` mutates page state ({hz.what}) "
+                f"without first harvesting the in-flight batch — call "
+                f"self._harvest() (or gate on self._inflight) before "
+                f"touching page tables/refcounts")
+
+
 class SuppressionReasonRule(Rule):
     """LINT000: a `# tpu-lint: disable=` comment without a `-- reason`."""
     code = "LINT000"
@@ -315,7 +342,7 @@ class SuppressionReasonRule(Rule):
 AST_RULES: Tuple[Rule, ...] = (
     HostSyncRule(), UnregisteredJitRule(), MissingDonateRule(),
     TracedBranchRule(), UntimedFetchRule(), BareExceptDeviceRule(),
-    SuppressionReasonRule(),
+    DoubleBufferHazardRule(), SuppressionReasonRule(),
 )
 
 # jaxpr-level checks (implemented in jaxpr_checks.py) share the catalog so
@@ -334,6 +361,16 @@ JAXPR_RULE_TABLE: Tuple[Tuple[str, str, str], ...] = (
     ("JXP005", "oversized-host-output",
      "serving-step output exceeds the O(B*K)-int budget or is logits-shaped "
      "— reintroduces the per-step [B, V] host fetch the fused step removed"),
+    # resource budgets (implemented in cost_model.py, enforced by tpu_cost)
+    ("JXP006", "oversized-replicated-buffer",
+     "an mp at-rest buffer replicated on every chip exceeds the declared "
+     "ceiling — the embedding/head replication that blocks 70B configs"),
+    ("JXP007", "undeclared-collective",
+     "collective traffic (psum/all-gather/reduce-scatter) undeclared in "
+     "SERVE_RESOURCE_BUDGET or above its per-step byte budget"),
+    ("JXP008", "peak-hbm-over-budget",
+     "a serving program's modeled peak HBM (donation-aware jaxpr liveness) "
+     "exceeds its declared per-executable budget"),
 )
 
 

@@ -110,6 +110,13 @@ class BroadHandler:
     device_calls: Set[str]
 
 
+@dataclasses.dataclass
+class DoubleBufferHazard:
+    node: ast.AST                       # the mutation (or its call site)
+    method: str                         # public entry-point qualname
+    what: str                           # description of the page-state write
+
+
 def _params_of(node: ast.AST) -> List[str]:
     a = node.args
     names = [x.arg for x in getattr(a, "posonlyargs", [])] + \
@@ -581,6 +588,133 @@ def _branch_on_param(test: ast.AST, data: Set[str]) -> Optional[str]:
 
 
 # ---------------------------------------------------------------------------
+# double-buffer hazards (TPL007)
+# ---------------------------------------------------------------------------
+
+# page-state mutators: calls that free/reassign KV pages or stores into the
+# per-slot length/table/refcount arrays.  A public entry point of a
+# double-buffered engine must harvest the in-flight batch before any of
+# these run, or the in-flight dispatch's KV writes land in pages the host
+# has already handed to someone else (the invariant `abort()` relies on).
+_PAGE_MUTATOR_ATTRS = frozenset({"release", "allocate", "allocate_prefixed"})
+_PAGE_STATE_ATTRS = frozenset({"lengths", "page_table", "refcounts",
+                               "ref_counts"})
+
+
+def _publishes_inflight(info: FunctionInfo) -> bool:
+    """Whether this function assigns a non-None value to `self._inflight` —
+    the double-buffering marker (fuse=True paths park the un-synced dispatch
+    there; `None` assignments are the harvest clearing it)."""
+    for node in ast.walk(info.node):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if dotted_name(t) == "self._inflight" and not (
+                        isinstance(node.value, ast.Constant) and
+                        node.value.value is None):
+                    return True
+    return False
+
+
+def _direct_mutations(info: FunctionInfo) -> List[Tuple[ast.AST, str]]:
+    """(node, description) for every direct page-state mutation in `info`."""
+    out: List[Tuple[ast.AST, str]] = []
+    for node in ast.walk(info.node):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _PAGE_MUTATOR_ATTRS:
+            out.append((node, f".{node.func.attr}()"))
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Attribute) and \
+                        t.value.attr in _PAGE_STATE_ATTRS:
+                    out.append((node, f".{t.value.attr}[...] store"))
+    return out
+
+
+def _direct_harvests(info: FunctionInfo) -> List[ast.AST]:
+    return [node for node in ast.walk(info.node)
+            if isinstance(node, ast.Call) and
+            (dotted_name(node.func) or "").split(".")[-1] == "_harvest"]
+
+
+def _double_buffer_hazards(index: ModuleIndex) -> List[DoubleBufferHazard]:
+    """Public methods of a double-buffered class that (transitively, same
+    file) mutate page-table/refcount state BEFORE any harvest of the
+    in-flight batch.  Position is compared by line number: the mutation's
+    position is its own line for a direct write, or the call site's line
+    when it happens inside a callee — so `step()`'s harvest-at-the-top
+    pattern and `abort()`'s harvest-guard both pass, and a tie (one call
+    that both harvests and mutates, like `run()` -> `step()`) passes too."""
+    classes = {info.scope for info in index.functions.values()
+               if info.scope and _publishes_inflight(info)}
+    if not classes:
+        return []
+    hazards: List[DoubleBufferHazard] = []
+    for cls in classes:
+        methods = {i.qualname.split(".")[-1]: i
+                   for i in index.functions.values() if i.scope == cls}
+
+        def closure(name: str) -> Set[str]:
+            seen: Set[str] = set()
+            work = [name]
+            while work:
+                cur = work.pop()
+                info = methods.get(cur)
+                if info is None or cur in seen:
+                    continue
+                seen.add(cur)
+                for call in info.calls:
+                    parts = call.split(".")
+                    if len(parts) == 2 and parts[0] in ("self", "cls") and \
+                            parts[1] in methods:
+                        work.append(parts[1])
+            return seen
+
+        mutates = {name: bool(_direct_mutations(i))
+                   for name, i in methods.items()}
+        harvests = {name: bool(_direct_harvests(i))
+                    for name, i in methods.items()}
+        for name, info in methods.items():
+            if name.startswith("_") or not isinstance(
+                    info.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            first_mut: Optional[Tuple[int, ast.AST, str]] = None
+            first_harv: Optional[int] = None
+            for node, what in _direct_mutations(info):
+                ln = getattr(node, "lineno", 1)
+                if first_mut is None or ln < first_mut[0]:
+                    first_mut = (ln, node, what)
+            for node in _direct_harvests(info):
+                ln = getattr(node, "lineno", 1)
+                if first_harv is None or ln < first_harv:
+                    first_harv = ln
+            # call sites into mutating / harvesting callees
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted_name(node.func)
+                parts = (d or "").split(".")
+                if len(parts) == 2 and parts[0] in ("self", "cls"):
+                    callee = parts[1]
+                    ln = getattr(node, "lineno", 1)
+                    sub = closure(callee)
+                    if any(mutates.get(m) for m in sub):
+                        if first_mut is None or ln < first_mut[0]:
+                            first_mut = (ln, node, f"via self.{callee}()")
+                    if any(m == "_harvest" or harvests.get(m) for m in sub):
+                        if first_harv is None or ln < first_harv:
+                            first_harv = ln
+            if first_mut is not None and (first_harv is None or
+                                          first_harv > first_mut[0]):
+                hazards.append(DoubleBufferHazard(
+                    first_mut[1], info.qualname, first_mut[2]))
+    return hazards
+
+
+# ---------------------------------------------------------------------------
 # broad except handlers around device code (TPL006)
 # ---------------------------------------------------------------------------
 
@@ -651,6 +785,7 @@ class FileContext:
         self.hot_sync_events = _hot_sync_events(self.index)
         self.traced_branches = _traced_branches(self.index)
         self.broad_device_handlers = _broad_device_handlers(tree)
+        self.db_hazards = _double_buffer_hazards(self.index)
 
 
 def iter_python_files(paths: Sequence[str]) -> List[str]:

@@ -315,7 +315,10 @@ class LLMEngine:
     `prefill_chunk=N` switches prompt processing from the bucketed one-shot
     ladder to N-token chunks interleaved one-per-step with decode.  Both are
     scheduler-level: the decode executable, page pool and table shapes are
-    identical in every mode.
+    identical in every mode.  `prefill_chunk="auto"` picks the chunk width
+    adaptively: `spec_len + 1` (the fused program is `max(spec_len+1,
+    chunk)` tokens wide, so a wider chunk pads every decode row), or one
+    page when spec is off.
 
     `spec_len=K` (> 0) enables speculative decoding: `draft_proposer`
     (default `NgramProposer`) guesses up to K continuation tokens per greedy
@@ -442,6 +445,19 @@ class LLMEngine:
             if b % page_size or b > max_model_len:
                 raise ValueError(f"bucket {b} incompatible with page_size "
                                  f"{page_size} / max_model_len {max_model_len}")
+        if spec_len < 0:
+            raise ValueError(f"spec_len must be >= 0, got {spec_len}")
+        if prefill_chunk == "auto":
+            # adaptive chunk width: the fused program's token width is
+            # max(spec_len+1, prefill_chunk), so any chunk wider than the
+            # verify lane pads EVERY decode row of EVERY fused dispatch with
+            # dead positions.  spec_len+1 makes the chunk ride the fused
+            # batch at exactly the width verify already needs (zero decode
+            # padding); with spec off there is no verify lane to hide
+            # behind, so fall back to one page per chunk — page-granular KV
+            # writes, and a bounded 1-page cost on decode rows.
+            prefill_chunk = min(spec_len + 1 if spec_len else page_size,
+                                max_model_len)
         if prefill_chunk is not None and not 1 <= prefill_chunk <= max_model_len:
             raise ValueError(f"prefill_chunk {prefill_chunk} outside "
                              f"[1, {max_model_len}]")
@@ -451,8 +467,6 @@ class LLMEngine:
         # largest bucket bounds any tail in one call
         self._chunk = prefill_chunk if self.chunked else self.buckets[-1]
         self.prefix_cache = prefix_cache
-        if spec_len < 0:
-            raise ValueError(f"spec_len must be >= 0, got {spec_len}")
         if spec_len and spec_len + 1 > max_model_len:
             raise ValueError(f"spec_len {spec_len} + 1 exceeds max_model_len")
         self.spec_len = spec_len

@@ -297,6 +297,67 @@ def test_tpl006_silent_on_narrow_except(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# TPL007 — page-state mutation with a double-buffered dispatch in flight
+# ---------------------------------------------------------------------------
+
+def test_tpl007_flags_mutation_before_harvest(tmp_path):
+    fs = lint_snippet(tmp_path, """
+        class Engine:
+            def _dispatch(self):
+                self._inflight = {"out": 1}     # double-buffer publication
+
+            def _harvest(self, finished):
+                self._inflight = None
+
+            def abort(self, rid):
+                self.cache.release(rid)         # in-flight batch not harvested
+                return True
+    """, rule="TPL007")
+    assert len(fs) == 1 and "harvest" in fs[0].message \
+        and "Engine.abort" in fs[0].message
+
+
+def test_tpl007_silent_when_harvested_first(tmp_path):
+    # the exact shape LLMEngine.abort/step use: harvest (or a guarded
+    # harvest) strictly before the first page-state mutation, including
+    # mutations reached through a callee (step -> _admit)
+    fs = lint_snippet(tmp_path, """
+        class Engine:
+            def _dispatch(self):
+                self._inflight = {"out": 1}
+
+            def _harvest(self, finished):
+                self._inflight = None
+
+            def _admit(self):
+                row = self.cache.allocate_prefixed(0, 4, None)
+
+            def abort(self, rid):
+                if self._inflight is not None:
+                    self._harvest([])
+                self.cache.release(rid)
+                return True
+
+            def step(self):
+                self._harvest([])
+                self._admit()
+    """, rule="TPL007")
+    assert fs == []
+
+
+def test_tpl007_silent_without_double_buffering(tmp_path):
+    # no `_inflight` publication = no in-flight batch to corrupt: a
+    # synchronous engine may mutate page state freely
+    fs = lint_snippet(tmp_path, """
+        class Engine:
+            def abort(self, rid):
+                self.cache.release(rid)
+                return True
+    """, rule="TPL007")
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
 # suppression machinery
 # ---------------------------------------------------------------------------
 

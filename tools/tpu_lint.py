@@ -8,8 +8,10 @@ layer of custom static checks — op-registry audits, API guards):
   step()-reachable code, TPL002 jit/shard_map site not in
   `analysis/registry.py`, TPL003 missing donation on hot buffers, TPL004
   Python branch on a traced value, TPL005 untimed blocking device fetch,
-  TPL006 broad except around device code, LINT000 suppression without a
-  reason.  Suppress per line with `# tpu-lint: disable=TPL001 -- reason`.
+  TPL006 broad except around device code, TPL007 page-state mutation with a
+  double-buffered dispatch in flight (harvest first), LINT000 suppression
+  without a reason.  Suppress per line with
+  `# tpu-lint: disable=TPL001 -- reason`.
 - **jaxpr** (`analysis/jaxpr_checks.py`): traces the serving executables
   (the fused one-dispatch step AND the --no-fuse legacy trio, mp1+mp2) and
   audits the programs — JXP001 embedded transfers, JXP002 donation
