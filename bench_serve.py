@@ -43,6 +43,17 @@ analytic flops vs compulsory HBM bytes over nameplate device specs) next to
 `measured_step_ms`, with `model_error` = measured/predicted — meaningful on
 TPU where the dispatch is device-bound, sanity-bounded only on the CPU smoke.
 
+`--oversubscribe F` (> 0) shrinks the page pool so the submitted token
+footprint is F x its capacity and flips admission to optimistic: prompt
+footprint reserved at admit, pages grown token-granularly, victims preempted
+under pressure (`--preempt {recompute,swap}` is the A/B axis — longer-prompt
+replay through the prefix cache vs host-side KV parking + h2d restore).  The
+JSON adds preemptions/step, the swap-vs-recompute split, swap_ms,
+`goodput_tokens_per_sec` (tokens in final outputs only — recompute replays
+earn nothing) and, from the unpressured comparison pass main() runs
+alongside, `goodput_ratio` + byte-exact `oversubscribe_parity`; page/swap
+accounting is invariant-checked at drain.
+
 `--mp N` serves tensor-parallel over N chips: Megatron-sharded serving params
 (qkv/fc1 column-, proj/fc2 row-split), page pool head-sharded, paged
 attention per-chip on the local head slice.  Greedy outputs are
@@ -75,6 +86,7 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
                     request_rate=float("inf"), seed=0, params=None,
                     prefill_chunk=None, prefix_cache=True,
                     shared_prefix_frac=0.0, spec_len=0, mp=1, fuse=True,
+                    oversubscribe=0.0, preempt="recompute",
                     trace_dir=None):
     """Replay a Poisson request stream through LLMEngine; returns the metrics
     dict (also the CI smoke entrypoint — tests assert on the executable
@@ -89,8 +101,18 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
     exact greedy parity.  mp > 1 serves tensor-parallel over the first mp
     devices (head-sharded paged attention + Megatron serving params);
     tokens/s-per-chip then divides by the mesh size — the honest multi-chip
-    number."""
+    number.
+
+    oversubscribe=F (> 0) stress-tests overload handling: the page pool is
+    shrunk so the submitted token footprint is F x its capacity, admission
+    flips to optimistic (prompt-footprint-only, token-granular growth) and
+    pool pressure preempts victims — `preempt` picks KV swap-out vs
+    recompute.  The JSON then carries preemptions/step, the swap-vs-
+    recompute split and `goodput_tokens_per_sec` (tokens in FINAL outputs
+    per second — replayed prefill work earns nothing), and the page/swap
+    accounting is invariant-checked at drain."""
     import hashlib
+    import math
 
     import jax
 
@@ -103,13 +125,6 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
         params = gpt_mod.init_params(config, jax.random.key(seed))
     max_model_len = max_model_len or config.max_seq_len
 
-    eng = LLMEngine(params, config, num_slots=num_slots, page_size=page_size,
-                    max_model_len=max_model_len, prefill_chunk=prefill_chunk,
-                    prefix_cache=prefix_cache, spec_len=spec_len, fuse=fuse,
-                    mp=mp if mp and mp > 1 else None,
-                    trace_ring=4096)    # ring must hold the whole timed run
-                                        # for the dispatches/sync aggregates
-    prefill_chunk = eng.prefill_chunk   # "auto" resolved by the engine
     rng = np.random.RandomState(seed)
     max_prompt = max_model_len - max_new_tokens
     shared = None
@@ -136,6 +151,36 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
     gaps = (rng.exponential(1.0 / request_rate, size=num_requests)
             if np.isfinite(request_rate) else np.zeros(num_requests))
     arrivals = np.cumsum(gaps)
+
+    admission = "reservation"
+    num_pages = None
+    if oversubscribe and oversubscribe > 0:
+        # shrink the pool so the submitted footprint is F x its token
+        # capacity (clamped so the single largest request still fits, plus
+        # one page of growth headroom) and admit optimistically — the whole
+        # point is to make growth fail and preemption carry the load.  One
+        # slot per request so LIVE TOKENS, not the slot count, bound
+        # concurrency (with 4 slots a pool sized against 32 submitted
+        # requests would never feel pressure); the F=1 pass through this
+        # same sizing is the "unpressured" comparison baseline — identical
+        # slot count, capacity == demand, zero (or near-zero) preemptions.
+        admission = "optimistic"
+        footprint = sum(int(p.size) + max_new_tokens for p in prompts)
+        need = math.ceil(footprint / (oversubscribe * page_size))
+        biggest = max(-(-(int(p.size) + max_new_tokens) // page_size)
+                      for p in prompts)
+        num_pages = max(need, biggest + 1) + 1      # +1: the null page
+        num_slots = max(num_slots, num_requests)
+
+    eng = LLMEngine(params, config, num_slots=num_slots, page_size=page_size,
+                    num_pages=num_pages,
+                    max_model_len=max_model_len, prefill_chunk=prefill_chunk,
+                    prefix_cache=prefix_cache, spec_len=spec_len, fuse=fuse,
+                    admission=admission, preempt=preempt,
+                    mp=mp if mp and mp > 1 else None,
+                    trace_ring=4096)    # ring must hold the whole timed run
+                                        # for the dispatches/sync aggregates
+    prefill_chunk = eng.prefill_chunk   # "auto" resolved by the engine
 
     # warmup: compile every executable the timed section can reach so it
     # measures steady-state serving, not compilation.  Random (non-shared)
@@ -166,6 +211,8 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
     # otherwise compare a compile-laden pass against a compile-light one)
     eng.warm_decode()
     eng.warm_spec()                     # verify executable (no-op spec off)
+    eng.warm_swap()                     # swap gather/scatter (no-op unless
+                                        # optimistic + preempt="swap")
     eng.reset_counters()
 
     pending = list(zip(arrivals, prompts))
@@ -197,6 +244,11 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
                 time.sleep(min(pending[0][0] - now, 0.01))
         dt = time.perf_counter() - t0
     assert len(outs) == num_requests, (len(outs), num_requests)
+    # drain invariant: free/LRU/in-use/swapped page partition exact, zero
+    # leaked pages — the oversubscribed run's hard acceptance bar, and cheap
+    # enough to assert on every run
+    eng.cache.check_invariants()
+    assert eng.cache.swapped_page_count == 0, "host swap pool leaked pages"
 
     st = eng.stats()
     lat = st["latency"]     # engine-side lifecycle histograms, seconds
@@ -248,6 +300,26 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
         "device_spec": dspec.name,
         "decode_tokens_per_sec_per_chip": round(decode_tokens / dt / n_chips, 1),
         "generated_tokens_per_sec": round(num_requests * max_new_tokens / dt, 1),
+        # goodput: tokens that made it into FINAL outputs per second —
+        # preempted-and-replayed prefill work earns nothing here, so the
+        # recompute tax shows up as goodput < decode throughput
+        "goodput_tokens_per_sec": round(
+            sum(len(o.token_ids) for o in outs) / dt, 1),
+        "admission": st["admission"],
+        "preempt_mode": st["preempt"],
+        "oversubscribe": oversubscribe,
+        "kv_num_pages": eng.cache.num_pages,
+        "preemptions": st["preemptions"],
+        "preemptions_per_step": round(
+            st["preemptions"] / max(st["engine_steps"], 1), 4),
+        "preempt_swaps": st["preempt_swaps"],
+        "preempt_recomputes": st["preempt_recomputes"],
+        "swapped_pages": st["swapped_pages"],
+        "swap_ms": round(st["swap_ms"], 3),
+        "recomputed_tokens": st["recomputed_tokens"],
+        "timeouts": st["timeouts"],
+        "rejected_requests": st["rejected_requests"],
+        "swap_executables": st["swap_executables"],
         "requests": num_requests,
         "elapsed_s": round(dt, 3),
         "ttft_p50_ms": round(lat["ttft_s"]["p50"] * 1e3, 2),
@@ -319,6 +391,21 @@ def main():
     ap.add_argument("--no-spec", action="store_true",
                     help="disable speculative decoding (also skips the "
                          "spec-off comparison pass)")
+    ap.add_argument("--oversubscribe", type=float, default=0.0,
+                    help="shrink the page pool so the submitted token "
+                         "footprint is F x its capacity and admit "
+                         "optimistically (prompt footprint only, token-"
+                         "granular growth, preemption under pressure); "
+                         "also runs an unpressured comparison pass "
+                         "reporting goodput_ratio + byte-exact "
+                         "oversubscribe_parity")
+    ap.add_argument("--preempt", choices=("recompute", "swap"),
+                    default="recompute",
+                    help="preemption mechanism under --oversubscribe: "
+                         "release + replay prompt+generated through the "
+                         "prefix cache (recompute), or park victim KV in a "
+                         "host-side pool and restore it by one h2d scatter "
+                         "(swap) — the A/B axis")
     ap.add_argument("--request-rate", type=float, default=None,
                     help="Poisson arrival rate in req/s (default: offline)")
     ap.add_argument("--trace-dir", type=str, default=None,
@@ -334,6 +421,8 @@ def main():
         ap.error("--spec-len must be >= 0")
     if args.mp < 1:
         ap.error("--mp must be >= 1")
+    if args.oversubscribe < 0:
+        ap.error("--oversubscribe must be >= 0")
     if args.prefill_chunk is not None and args.prefill_chunk != "auto":
         try:
             args.prefill_chunk = int(args.prefill_chunk)
@@ -358,6 +447,7 @@ def main():
     kw = dict(prefill_chunk=args.prefill_chunk,
               prefix_cache=not args.no_prefix_cache,
               shared_prefix_frac=args.shared_prefix_frac,
+              oversubscribe=args.oversubscribe, preempt=args.preempt,
               mp=args.mp)
     if on_tpu:
         config = GPTConfig(vocab_size=50304, hidden_size=2048, num_layers=24,
@@ -376,6 +466,21 @@ def main():
     fuse = not args.no_fuse
     stats = run_serve_bench(spec_len=spec_len, fuse=fuse,
                             trace_dir=args.trace_dir, **kw)
+    if args.oversubscribe > 0:
+        # unpressured comparison on the SAME stream at F=1 (pool capacity ==
+        # submitted footprint, same slot count and machinery, no pressure):
+        # preemption must cost throughput, not tokens — greedy outputs
+        # byte-identical, goodput_ratio the honest price of running F x
+        # oversubscribed
+        base = run_serve_bench(spec_len=spec_len, fuse=fuse,
+                               **dict(kw, oversubscribe=1.0))
+        stats["unpressured_goodput_tokens_per_sec"] = \
+            base["goodput_tokens_per_sec"]
+        stats["goodput_ratio"] = round(
+            stats["goodput_tokens_per_sec"] /
+            max(base["goodput_tokens_per_sec"], 1e-9), 3)
+        stats["oversubscribe_parity"] = \
+            stats["outputs_digest"] == base["outputs_digest"]
     if spec_len:
         # spec on/off delta on the SAME stream: greedy acceptance is lossless,
         # so the digests must match and the tokens/s ratio is the honest win

@@ -794,8 +794,21 @@ def run_cost_checks(include_mp: bool = True, mp: int = 2,
         costs, fs = audit_resources(serving_targets(m, engines=(eng, leg)),
                                     at_rest, budget)
         findings.extend(fs)
+        # JXP009: the HOST swap pool (preempt="swap" KV parking) is sized,
+        # not traced — its declared ceiling is audited exactly, once per
+        # mesh pass (host memory does not shard: the bound is per host)
+        swap_cap = budget.get("swap_pool_bytes")
+        swap_bytes = eng.swap_pool_bytes()
+        if swap_cap is not None and swap_bytes > swap_cap:
+            findings.append(Finding(
+                "JXP009", "<at-rest>", 0, 0,
+                f"host swap pool bound {swap_bytes} bytes exceeds the "
+                f"declared swap_pool_bytes budget {swap_cap} — size "
+                f"swap_pool_pages down or raise the budget with the host "
+                f"memory math that justifies it"))
         reports[m] = {
             "at_rest": at_rest.to_json(),
+            "swap_pool_bytes": swap_bytes,
             # predicted_ms computed HERE through ProgramCost.predicted_ms so
             # the CLI report and the bench JSON share one roofline formula
             "programs": [dict(c.to_json(),

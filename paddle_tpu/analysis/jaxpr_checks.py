@@ -32,8 +32,10 @@ audited:
 `audit_jaxpr` is the reusable core (tests feed it toy jits for
 positive/negative pairs); `run_jaxpr_checks` builds tiny CPU engines (the
 default fused engine AND the `fuse=False` legacy trio, so the `--no-fuse`
-escape hatch stays audited) and checks the real serving set, plus an mp=2
-pass when enough devices exist.
+escape hatch stays audited) and checks the real serving set — fused step,
+legacy decode/chunk/verify, bucketed prefill, COW copy, and the two
+preemption KV-swap copies (swap-out gather / swap-in scatter) — plus an
+mp=2 pass when enough devices exist.
 """
 from __future__ import annotations
 
@@ -284,6 +286,7 @@ def serving_targets(mp: int = 1, engines=None
     bucket = eng.buckets[0]
     T = leg.spec_len + 1
     Tf = eng._fused_T
+    cfgL = eng._pool["k"].shape[0]      # layers: swap staging leading dim
     return [
         (f"serve.{tag}fused_step", unwrap(eng._decode_fn),
          (eng.params, jnp.zeros((B, Tf), i32), eng._pool,
@@ -313,6 +316,20 @@ def serving_targets(mp: int = 1, engines=None
          dict(donate_paths=("arg2",), keep_paths=("arg0",), **mp_kw)),
         (f"serve.{tag}cow_copy", unwrap(eng._copy_fn),
          (eng._pool, jnp.zeros((), i32), jnp.ones((), i32)),
+         dict(donate_paths=("arg0",), **mp_kw)),
+        # preemption KV swap copies: the swap-out gather reads the pool into
+        # a standalone buffer (pool NOT donated — it stays live; its output
+        # IS a host-bound bulk fetch, so no host_output_budget applies); the
+        # swap-in scatter restores in place (pool donated).
+        (f"serve.{tag}swap_out", unwrap(eng._swap_out_fn),
+         (eng._pool, jnp.zeros((P,), i32)),
+         dict(keep_paths=("arg0",), **mp_kw)),
+        (f"serve.{tag}swap_in", unwrap(eng._swap_in_fn),
+         (eng._pool, jnp.zeros((P,), i32),
+          jnp.zeros((cfgL, P) + eng._pool["k"].shape[2:],
+                    eng._pool["k"].dtype),
+          jnp.zeros((cfgL, P) + eng._pool["k"].shape[2:],
+                    eng._pool["k"].dtype)),
          dict(donate_paths=("arg0",), **mp_kw)),
     ]
 

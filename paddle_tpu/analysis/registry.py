@@ -39,12 +39,18 @@ from typing import Dict, List, Optional, Tuple
 # interleaved prefill chunk all ride it, with sampling and the accept scan on
 # device.  The prefill budget covers the cold paths (bucketed one-shot +
 # prefix-tail chunk in bucketed mode; zero programs in chunked mode, where
-# the chunk rides the fused batch), plus one COW page copy.
+# the chunk rides the fused batch), plus one COW page copy.  The swap budget
+# (oversubscription PR) covers the two preemption KV-swap copies — ONE
+# fixed-shape gather (`swap_out_pages`, victim pages padded to the slot
+# capacity) and ONE scatter (`swap_in_pages`) — compiled only when
+# `preempt="swap"` actually preempts, so the default reservation-mode bench
+# measures 0 against this <= 2 bound (total 4 -> 6 is the documented bump).
 SERVE_PROGRAM_BUDGET: Dict[str, int] = {
     "decode_side_executables": 1,   # THE fused serve_step_paged program
     "prefill_executables": 2,
     "copy_executables": 1,
-    "total_executables": 4,
+    "swap_executables": 2,          # preemption swap-out gather + swap-in scatter
+    "total_executables": 6,
 }
 
 # Per-mesh-config budget under tensor parallelism: the AOT path keeps counts
@@ -54,7 +60,8 @@ SERVE_PROGRAM_BUDGET_MP: Dict[str, int] = {
     "decode_side_executables": 1,
     "prefill_executables": 2,
     "copy_executables": 1,
-    "total_executables": 4,
+    "swap_executables": 2,
+    "total_executables": 6,
 }
 
 # ---------------------------------------------------------------------------
@@ -89,6 +96,13 @@ SERVE_RESOURCE_BUDGET: Dict[str, object] = {
         "bucketed_prefill": 850_000,
         "verify": 940_000,
         "cow_copy": 190_000,
+        # preemption KV swap copies (oversubscription PR): the gather holds
+        # pool + one slot-capacity staging buffer; the scatter holds pool +
+        # two staging uploads.  Measured 2026-08 (swap_out 139k/172k mp1/mp2,
+        # swap_in 139k/213k; collective-free at mp2 — the page axis is
+        # unsharded) + ~30% headroom.
+        "swap_out": 230_000,
+        "swap_in": 280_000,
     },
     # Per-executable collective bytes per step (JXP007), keyed by the FULL
     # target name: only the mp2 programs may communicate at all (Megatron
@@ -103,6 +117,13 @@ SERVE_RESOURCE_BUDGET: Dict[str, object] = {
         "serve.mp2.bucketed_prefill": 24_576,
         "serve.mp2.verify": 20_480,
     },
+    # HOST-side swap pool ceiling (oversubscription PR): the bound
+    # `LLMEngine.swap_pool_bytes()` declares for preempt="swap" KV parking —
+    # audit engine: 8 pages x (2 layers x 8 tok x 4 KVH x 16 hd x 4 B x k+v)
+    # = 64 KiB, checked exactly (the host pool is sized, not traced).  The
+    # yardstick for the quantized-KV arc: halving page bytes must halve this
+    # ceiling too (JXP009).
+    "swap_pool_bytes": 65_536,
 }
 
 
@@ -134,8 +155,10 @@ PROGRAM_SOURCES: Tuple[ProgramSource, ...] = (
              "shapes per engine.  Fused (default): serve_step_paged — THE "
              "one-dispatch step (decode + verify + interleaved chunk in one "
              "[B, max(K+1, chunk)] batch, on-device sampling/acceptance, "
-             "O(B*K)-int host output) — plus the cold prefill paths and the "
-             "COW copy; fuse=False additionally builds the legacy decode/"
+             "O(B*K)-int host output) — plus the cold prefill paths, the "
+             "COW copy and the two preemption KV-swap copies (swap_out "
+             "gather / swap_in scatter, compiled only when preempt='swap' "
+             "fires); fuse=False additionally builds the legacy decode/"
              "chunk/verify trio (A/B baseline, outside the default budget)"),
     # ---- model core -------------------------------------------------------
     ProgramSource(

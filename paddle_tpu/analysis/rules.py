@@ -371,6 +371,10 @@ JAXPR_RULE_TABLE: Tuple[Tuple[str, str, str], ...] = (
     ("JXP008", "peak-hbm-over-budget",
      "a serving program's modeled peak HBM (donation-aware jaxpr liveness) "
      "exceeds its declared per-executable budget"),
+    ("JXP009", "swap-pool-over-budget",
+     "the engine's host-side KV swap pool bound exceeds the declared "
+     "swap_pool_bytes budget — preemption parking must stay host-memory "
+     "accountable"),
 )
 
 

@@ -7,12 +7,22 @@ methods are O(pages) host operations — the device only ever sees the
 fixed-shape `[num_slots, max_pages_per_slot]` table and `[num_slots]`
 lengths, so the compiled decode step never changes shape.
 
-Allocation is reservation-based: a request's full footprint
-(prompt + max_new_tokens, rounded up to pages) is reserved at admission, so a
-running sequence can never hit out-of-pages mid-decode (preemption/swapping is
-an open item, see ROADMAP).  Page 0 is reserved as the null page: unreserved
-table entries point at it, inactive slots write to it, and attention masking
-by length guarantees it is never read.
+Two allocation disciplines (the engine's `admission=` knob):
+
+- **reservation** (default): a request's full footprint
+  (prompt + max_new_tokens, rounded up to pages) is reserved at admission, so
+  a running sequence can never hit out-of-pages mid-decode.
+- **optimistic** (vLLM-style, Kwon et al. §4.3): only the prompt footprint is
+  reserved at admission and the slot's pages `grow()` token-granularly as
+  decode proceeds — live tokens, not worst-case reservations, bound
+  concurrency.  A failed `grow()` is the engine's preemption trigger: the
+  victim's pages either swap to a host-side pool (its page count tracked
+  here as the fourth `swapped` partition, `note_swap_out`/`note_swap_in`) or
+  are simply released and the sequence recomputed later as a longer prompt.
+
+Page 0 is reserved as the null page: unreserved table entries point at it,
+inactive slots write to it, and attention masking by length guarantees it is
+never read.
 
 Prefix cache (vLLM copy-on-write page sharing): prompt pages whose KV has
 been fully written are registered in a trie-shaped index keyed by
@@ -81,6 +91,11 @@ class PagedKVCache:
         self._node_ids = itertools.count(1)
         self.prefix_evictions = 0
         self._evictions_counter = None      # metrics mirror, see attach_metrics
+        # fourth partition: pages whose KV content lives in the HOST swap
+        # pool, keyed by request id (the device pages themselves were
+        # released — this tracks the off-device obligation so drain checks
+        # can prove nothing leaked there either)
+        self._swapped: Dict[int, int] = {}
 
     # ---- capacity queries -------------------------------------------------
     @property
@@ -121,6 +136,30 @@ class PagedKVCache:
         engine's memory claim is measured against (vs num_slots * max_len)."""
         return (self.num_pages - 1) * self.page_size
 
+    def pages_held(self, slot: int) -> int:
+        """Pages currently mapped into `slot`'s table row (shared + private)
+        — one of the three victim-selection signals."""
+        return len(self._used[slot])
+
+    def slot_pages(self, slot: int) -> List[int]:
+        """The slot's page ids in table-row order (a copy)."""
+        return list(self._used[slot])
+
+    @property
+    def swapped_page_count(self) -> int:
+        """Pages whose KV currently lives in the host swap pool."""
+        return sum(self._swapped.values())
+
+    @property
+    def swapped_requests(self) -> int:
+        """Requests currently parked in the host swap pool."""
+        return len(self._swapped)
+
+    def pool_pressure(self) -> float:
+        """Fraction of the real pool in live use (0.0 idle .. 1.0 full) —
+        the overload gauge victim selection and dashboards key on."""
+        return self.pages_in_use() / max(1, self.num_pages - 1)
+
     def attach_metrics(self, registry) -> None:
         """Register page-accounting observability on a
         `inference.metrics.MetricsRegistry`: pull gauges over the free/in-use/
@@ -138,6 +177,10 @@ class PagedKVCache:
                        "refcount-0 cached prefix pages, reclaimable on demand")
         registry.gauge("prefix_cached_pages", lambda: len(self._index),
                        "pages registered in the prefix index")
+        registry.gauge("kv_pages_swapped", lambda: self.swapped_page_count,
+                       "pages whose KV lives in the host swap pool")
+        registry.gauge("kv_pool_pressure", self.pool_pressure,
+                       "fraction of the page pool in live use")
 
     # ---- prefix index -----------------------------------------------------
     def _match(self, tokens: np.ndarray
@@ -282,6 +325,51 @@ class PagedKVCache:
             matched += partial.n_tokens
         return self.page_table[slot], matched, cow
 
+    def grow(self, slot: int, total_tokens: int) -> None:
+        """Optimistic admission's token-granular growth: extend `slot`'s
+        mapping so it covers `total_tokens` positions, allocating fresh pages
+        (evicting LRU-parked prefixes on demand) past what it already holds.
+        No-op when the slot already covers the footprint — the engine calls
+        this before every decode/verify dispatch, so the common case must be
+        one integer compare.  Raises RuntimeError when the pool cannot supply
+        the pages — the engine's preemption trigger."""
+        n = self.pages_needed(total_tokens)
+        have = len(self._used[slot])
+        if n <= have:
+            return
+        if n > self.max_pages_per_slot:
+            raise ValueError(
+                f"slot {slot} growth to {total_tokens} tokens exceeds slot "
+                f"capacity {self.max_pages_per_slot * self.page_size}")
+        need = n - have
+        self._evict(need)
+        if need > len(self._free):
+            raise RuntimeError(
+                f"out of KV pages growing slot {slot}: need {need}, "
+                f"free {len(self._free)}")
+        fresh = [self._free.pop() for _ in range(need)]
+        for p in fresh:
+            self._ref[p] = 1
+        self.page_table[slot, have:n] = fresh
+        self._used[slot].extend(fresh)
+
+    # ---- host swap pool accounting (fourth partition) ---------------------
+    def note_swap_out(self, request_id: int, n_pages: int) -> None:
+        """Record that `n_pages` of KV for `request_id` now live in the host
+        swap pool (the device pages are released separately — this partition
+        tracks the off-device obligation)."""
+        if n_pages < 1:
+            raise ValueError(f"swap-out of {n_pages} pages")
+        if request_id in self._swapped:
+            raise RuntimeError(f"request {request_id} already swapped out")
+        self._swapped[request_id] = n_pages
+
+    def note_swap_in(self, request_id: int) -> int:
+        """Clear `request_id`'s swap-pool obligation (swap-in completed, the
+        request was aborted/timed out, or the swap degraded to recompute).
+        Returns the page count released from the host pool (0 if unknown)."""
+        return self._swapped.pop(request_id, 0)
+
     def release(self, slot: int) -> None:
         """Retire a slot: decrement its pages' refcounts; pages reaching 0 go
         back to the free list, unless they are registered cached prefixes —
@@ -335,6 +423,13 @@ class PagedKVCache:
             assert self._index.get(node.key) is node, "LRU node unregistered"
         for page, node in self._page_node.items():
             assert node.page == page
+        # fourth (host-side) partition: every swap-pool obligation is a
+        # positive page count, and the total matches the O(1) mirror — a
+        # swapped request that was aborted/resumed without clearing its entry
+        # is a host-pool leak even though the device partition looks clean
+        for rid, n in self._swapped.items():
+            assert 0 < n <= self.max_pages_per_slot, \
+                f"swapped request {rid} records {n} pages"
 
     def prefix_stats(self) -> Dict[str, int]:
         return {

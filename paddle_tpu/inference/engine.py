@@ -109,9 +109,39 @@ and Orca's iteration-level scheduling (Yu et al., OSDI 2022), under the same
   device capture when available.  Instrumentation is host-only: zero new
   compiled programs, spans skipped entirely unless a trace is recording.
 
+- **Oversubscribed admission** (vLLM preempt-then-swap-or-recompute, Kwon et
+  al. §4.3, over the Sarathi chunked-prefill machinery) —
+  `admission="optimistic"` admits on the PROMPT footprint only and grows a
+  slot's pages token-granularly as decode proceeds (`PagedKVCache.grow`), so
+  live tokens — not worst-case `prompt + max_new_tokens` reservations —
+  bound concurrency.  When a growth allocation fails, the engine preempts:
+  victims picked by (priority, pages-held, progress), the in-flight
+  double-buffered batch harvested first (the TPL007 discipline holds by
+  construction: growth runs after the step-top harvest), then either
+  **recompute** — the victim's pages are released and it re-queues at the
+  head with prompt+generated replayed as a longer prompt through the prefix
+  cache and chunked prefill — or **swap** (`preempt="swap"`): its pages are
+  gathered into a standalone device buffer (`models.gpt.swap_out_pages`, ONE
+  fixed-shape executable padded to the slot capacity), the d2h fetch
+  overlapped against the next decode dispatch, content parked in a bounded
+  host-side numpy pool (`swap_pool_pages`, the fourth `swapped` page
+  partition in `PagedKVCache.check_invariants`), and restored by one h2d
+  scatter on re-admission (`swap_in_pages`) — no prefill replay at all.
+  Greedy outputs are byte-identical preempted-vs-undisturbed: recompute
+  replays land on the same chunk/verify logits parity the prefix cache
+  already guarantees, and swap restores bit-exact KV.  Requests whose
+  worst-case footprint can never fit the pool are rejected at `add_request`
+  (`finish_reason="rejected"`) instead of wedging the queue head; a
+  per-request `deadline_s` retires overdue work as
+  `finish_reason="timeout"`; and an injectable `inference.faults.FaultPlan`
+  forces pool pressure / failing swap copies / clock skew so tests can drive
+  every preempt interleaving deterministically.
+
 `bench_serve.py` replays a Poisson request stream through this engine and
 reports decode tokens/s/chip, TTFT percentiles, prefix-cache hit rate,
-accepted tokens per verify step and compiled-program counts.
+accepted tokens per verify step, compiled-program counts and — under
+`--oversubscribe F` — preemptions/step, the swap-vs-recompute split and
+goodput vs an unpressured replay.
 """
 from __future__ import annotations
 
@@ -131,6 +161,7 @@ import numpy as np
 from ..models import gpt as gpt_mod
 from ..profiler import profiler as _prof
 from .cache import PagedKVCache
+from .faults import FaultInjected, FaultPlan
 from .metrics import MetricsRegistry
 from .spec import DraftProposer, NgramProposer
 
@@ -141,13 +172,18 @@ class Request:
 
     temperature=None inherits the engine's sampling mode; 0.0 forces the
     greedy fast path for this request (argmax, PRNG-key independent) even on
-    a sampling engine.  eq=False: identity comparison only — the generated
-    __eq__ would compare numpy prompts, whose truth value is ambiguous."""
+    a sampling engine.  priority orders preemption victims (LOWER priorities
+    are preempted first; default 0); deadline is the absolute engine-clock
+    instant past which the request is retired as finish_reason="timeout".
+    eq=False: identity comparison only — the generated __eq__ would compare
+    numpy prompts, whose truth value is ambiguous."""
     prompt: np.ndarray
     max_new_tokens: int = 16
     request_id: int = -1
     t_enqueue: float = 0.0
     temperature: Optional[float] = None
+    priority: int = 0
+    deadline: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -169,6 +205,7 @@ class RequestMetrics:
     e2e_s: Optional[float] = None           # t_finish - t_enqueue
     cached_tokens: int = 0                  # prompt tokens from the prefix cache
     n_generated: int = 0
+    preemptions: int = 0                    # times this request was preempted
 
 
 @dataclasses.dataclass
@@ -176,7 +213,9 @@ class RequestOutput:
     request_id: int
     prompt: np.ndarray
     token_ids: List[int]            # generated tokens (prompt excluded)
-    finish_reason: str              # "stop" (EOS) | "length" (budget) | "abort"
+    finish_reason: str              # "stop" (EOS) | "length" (budget) |
+                                    # "abort" | "timeout" (deadline) |
+                                    # "rejected" (footprint can never fit)
     cached_tokens: int = 0          # prompt tokens served from the prefix cache
     ttft_s: Optional[float] = None  # enqueue -> first generated token
     metrics: Optional[RequestMetrics] = None    # full lifecycle record
@@ -208,11 +247,20 @@ class _Running:
 class _Prefilling:
     """A slot whose prompt KV is still landing: `filled` prompt tokens are in
     pages (prefix-cache hits + completed chunks); the slot joins the decode
-    set only once filled == len(prompt)."""
+    set only once filled == len(prompt).  `prompt` is the EFFECTIVE prompt
+    being prefilled — for a preempted request resuming via recompute it is
+    the original prompt + the tokens in `prior` (generation already banked),
+    replayed as one longer prompt; `ttft`/`spec_off`/`streak` carry the
+    pre-preemption state back into the decode set."""
     request: Request
     slot: int
     filled: int
     cached_tokens: int
+    prompt: np.ndarray = None
+    prior: Optional[List[int]] = None
+    ttft: Optional[float] = None
+    spec_off: bool = False
+    streak: int = 0
 
 
 def _pow2_buckets(lo: int, hi: int) -> List[int]:
@@ -258,6 +306,8 @@ ENGINE_SPANS = (
     "engine.spec.accept",
     "engine.decode.dispatch",
     "engine.sample.sync",
+    "engine.swap.d2h",
+    "engine.swap.h2d",
 )
 
 
@@ -352,6 +402,25 @@ class LLMEngine:
     the monotonic clock behind every lifecycle stamp (default
     `time.perf_counter`) so tests drive deterministic latencies.
 
+    Overload behavior: `admission="optimistic"` admits on the prompt
+    footprint only and grows pages token-granularly as decode proceeds —
+    live-token capacity, not worst-case reservations, bounds concurrency.
+    On pool pressure (a failed growth) the engine preempts victims — lowest
+    `priority` first, then most pages held, least progress, youngest —
+    and either releases + re-queues them for recompute (prompt+generated
+    replayed as a longer prompt through the prefix cache; the default) or
+    swaps their KV pages to a bounded host-side pool (`preempt="swap"`,
+    `swap_pool_pages` cap) restored by one h2d scatter on re-admission.
+    Greedy outputs stay byte-identical preempted-vs-undisturbed.
+    `admission="reservation"` (default) keeps the PR-1 full-footprint
+    reservation discipline — no growth, no preemption.  Per-request
+    `deadline_s` retires overdue work as `finish_reason="timeout"`; a
+    request whose `prompt + max_new_tokens` footprint exceeds the whole pool
+    is rejected at `add_request` (`finish_reason="rejected"`) instead of
+    wedging the queue head.  `fault_plan` injects deterministic pool
+    pressure / swap-copy failures / clock skew (tests only; see
+    `inference.faults.FaultPlan`).
+
     `mp=N` (or an explicit `mesh` with an 'mp' axis) serves tensor-parallel
     over N chips: params are placed ONCE at init in the Megatron serving
     layout (`parallel.hybrid.serving_param_specs` — qkv/fc1 column-, proj/fc2
@@ -379,6 +448,10 @@ class LLMEngine:
                  spec_backoff_window: int = 8,
                  fuse: bool = True,
                  double_buffer: Optional[bool] = None,
+                 admission: str = "reservation",
+                 preempt: str = "recompute",
+                 swap_pool_pages: Optional[int] = None,
+                 fault_plan: Optional[FaultPlan] = None,
                  mesh=None, mp: Optional[int] = None,
                  seed: int = 0,
                  clock: Optional[Callable[[], float]] = None,
@@ -485,8 +558,29 @@ class LLMEngine:
             (True if double_buffer is None else bool(double_buffer))
         self._fused_T = max(self.spec_len + 1,
                             prefill_chunk if self.chunked else 1)
+        if admission not in ("reservation", "optimistic"):
+            raise ValueError(f"admission must be 'reservation' or "
+                             f"'optimistic', got {admission!r}")
+        if preempt not in ("recompute", "swap"):
+            raise ValueError(f"preempt must be 'recompute' or 'swap', "
+                             f"got {preempt!r}")
+        self.admission = admission
+        self.optimistic = admission == "optimistic"
+        self.preempt = preempt
+        self._faults = fault_plan or FaultPlan()
         self.cache = PagedKVCache(num_pages, page_size, num_slots,
                                   max_pages_per_slot)
+        # host swap pool bound, in pages (preempt="swap" parks victim KV
+        # here): default mirrors the device pool — the host obligation can
+        # never exceed what the device could hold
+        self.swap_pool_pages = (num_pages - 1) if swap_pool_pages is None \
+            else int(swap_pool_pages)
+        if self.swap_pool_pages < 0:
+            raise ValueError(
+                f"swap_pool_pages must be >= 0, got {swap_pool_pages}")
+        # optimistic-admission watermark: global free-page headroom kept back
+        # at admission (vLLM's watermark_blocks), ~1% of the pool
+        self._watermark = max(1, (self.cache.num_pages - 1) // 100)
         self._pool = gpt_mod.init_paged_cache(config, num_pages, page_size)
         if self._pool_sharding is not None:
             self._pool = jax.device_put(
@@ -543,6 +637,26 @@ class LLMEngine:
             "finished_requests", "requests retired by stop/length")
         self._aborted_requests = m.counter("aborted_requests",
                                            "requests retired by abort()")
+        self._preemptions = m.counter(
+            "preemptions", "running requests evicted under pool pressure")
+        self._preempt_swaps = m.counter(
+            "preempt_swaps",
+            "preemptions whose KV swap-out d2h completed")
+        self._preempt_recomputes = m.counter(
+            "preempt_recomputes",
+            "preemptions resolved by recompute (incl. degraded swaps)")
+        self._swapped_pages_c = m.counter(
+            "swapped_pages", "KV pages delivered to the host swap pool")
+        self._swap_ms_c = m.counter(
+            "swap_ms", "milliseconds spent in swap d2h/h2d copies")
+        self._recomputed_tokens = m.counter(
+            "recomputed_tokens",
+            "prompt tokens re-prefilled because of preemption")
+        self._timeouts = m.counter(
+            "timeouts", "requests retired by deadline expiry")
+        self._rejected_requests = m.counter(
+            "rejected_requests",
+            "requests rejected at intake (footprint can never fit)")
         self._h_queue = m.histogram("queue_time_seconds",
                                     help="enqueue -> admission into a slot")
         self._h_ttft = m.histogram("ttft_seconds",
@@ -649,6 +763,23 @@ class LLMEngine:
             return pin_pool({n: a.at[:, dst].set(a[:, src])
                              for n, a in pool.items()})
 
+        def swap_out_impl(pool, ids):
+            # preemption swap-out: gather the victim's pages into a fresh
+            # buffer (pool NOT donated — it stays live) so the d2h fetch can
+            # overlap the next decode dispatch; ids padded to the slot
+            # capacity keep this ONE fixed-shape executable.  The pin keeps
+            # the gathered buffer in the pool's KVH-sharded layout under mp
+            # (the gather stays chip-local; the host fetch assembles).
+            return pin_pool(gpt_mod.swap_out_pages(pool, ids))
+
+        def swap_in_impl(pool, ids, k, v):
+            # preemption swap-in: scatter the parked KV back into freshly
+            # allocated pages, in place.  Only the pool is donated — the k/v
+            # staging uploads cannot alias the pool-shaped output, so
+            # donating them would just burn a "donation unusable" warning
+            # per swap-in
+            return pin_pool(gpt_mod.swap_in_pages(pool, ids, k, v))
+
         # pool donated: each step updates it in place instead of copying the
         # whole page pool every iteration.  The mp path AOT-compiles (see
         # _AotCache) so the program set stays exact under committed-sharded
@@ -673,10 +804,23 @@ class LLMEngine:
             self._chunk_fn = jit_(chunk_impl, (2,), 1)
         self._prefill_fn = jit_(prefill_impl, (2,), 1)
         self._copy_fn = jit_(copy_impl, (0,))
+        self._swap_out_fn = jit_(swap_out_impl, ())
+        self._swap_in_fn = jit_(swap_in_impl, (0,))
         self._seen_buckets = set()
         self._chunk_used = False
         self._copy_used = False
+        self._swap_out_used = False
+        self._swap_in_used = False
         self._decode_used = False       # any decode-side dispatch happened
+        # preemption/overload state: rid -> resume record ("recompute" keeps
+        # the banked generation for the longer-prompt replay; "swap" adds the
+        # parked KV, first as un-synced device buffers then host numpy);
+        # _pending_d2h holds swap records whose d2h fetch is deferred past
+        # the next dispatch; _has_deadlines gates the per-step expiry scan
+        self._preempted: Dict[int, Dict[str, object]] = {}
+        self._pending_d2h: List[Dict[str, object]] = []
+        self._has_deadlines = False
+        self._step_preempted = 0
         # double-buffer state: the un-synced result of the last fused
         # dispatch (device arrays + the host metadata to interpret them) and
         # finishes surfaced outside step() (an abort-time harvest)
@@ -701,12 +845,24 @@ class LLMEngine:
 
     # ---- request intake ---------------------------------------------------
     def add_request(self, prompt, max_new_tokens: int = 16,
-                    temperature: Optional[float] = None) -> int:
+                    temperature: Optional[float] = None,
+                    priority: int = 0,
+                    deadline_s: Optional[float] = None) -> int:
         """Enqueue one request.  temperature=None inherits the engine's
         sampling mode; 0.0 is the per-request greedy fast path (argmax pick,
         output independent of the PRNG stream — what speculative decoding
         verifies against).  A positive value must equal the engine's compiled
-        temperature: the sampling variant is baked into the executables."""
+        temperature: the sampling variant is baked into the executables.
+
+        `priority` orders preemption under optimistic admission (lower
+        priorities are evicted first; default 0).  `deadline_s` bounds the
+        request's total wall time: past `enqueue + deadline_s` it is retired
+        with finish_reason="timeout" wherever it is (queued, prefilling,
+        decoding, or swapped out).  A request whose worst-case footprint
+        (prompt + max_new_tokens) exceeds the whole page pool can NEVER be
+        served — it is rejected immediately (finish_reason="rejected",
+        output available via outputs/run()) instead of wedging the queue
+        head forever while it waits for pages that cannot exist."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -732,11 +888,24 @@ class LLMEngine:
         if total > self.max_model_len:
             raise ValueError(f"prompt + max_new_tokens = {total} exceeds "
                              f"max_model_len {self.max_model_len}")
+        if deadline_s is not None and deadline_s <= 0.0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         rid = next(self._ids)
         t = self._now()
-        self._queue.append(Request(prompt, max_new_tokens, rid, t,
-                                   temperature))
+        deadline = None if deadline_s is None else t + deadline_s
+        req = Request(prompt, max_new_tokens, rid, t, temperature,
+                      priority, deadline)
         self._lifecycles[rid] = RequestMetrics(t_enqueue=t)
+        if self.cache.pages_needed(total) > self.cache.num_pages - 1:
+            # fail fast: even alone on an empty pool this footprint cannot
+            # fit — queueing it would wedge the queue head forever in
+            # _admit's wait-for-pages path
+            self._rejected_requests.inc()
+            self._finish_output(req, [], "rejected", 0, None)
+            return rid
+        if deadline is not None:
+            self._has_deadlines = True
+        self._queue.append(req)
         return rid
 
     def _req_greedy(self, req: Request) -> bool:
@@ -764,15 +933,23 @@ class LLMEngine:
                 # prompt comparison has no scalar truth value (it raised for
                 # any aborted request not at the head of the queue)
                 del self._queue[i]
-                self._finish_output(req, [], "abort", 0, None)
+                rec = self._drop_preempted(request_id)
+                if rec is not None:
+                    # a preempted request keeps the tokens it had produced
+                    self._finish_output(req, list(rec["generated"]), "abort",
+                                        rec["cached_tokens"], rec["ttft"])
+                else:
+                    self._finish_output(req, [], "abort", 0, None)
                 return True
         for slot, st in list(self._prefilling.items()):
             if st.request.request_id == request_id:
                 del self._prefilling[slot]
                 self.cache.release(slot)
                 self._free_slots.append(slot)
-                self._finish_output(st.request, [], "abort",
-                                    st.cached_tokens, None)
+                # a recompute-resume mid-replay keeps its banked generation
+                # (same contract as the queued and timeout paths)
+                self._finish_output(st.request, list(st.prior or []),
+                                    "abort", st.cached_tokens, st.ttft)
                 return True
         for slot, seq in list(self._running.items()):
             if seq.request.request_id == request_id:
@@ -787,9 +964,11 @@ class LLMEngine:
     def _finish_output(self, req: Request, token_ids: List[int], reason: str,
                        cached: int, ttft: Optional[float]) -> RequestOutput:
         """Close the request's lifecycle record and publish the output.
-        Latency histograms only see stop/length retirements — an abort's
-        wall time measures the client, not the engine — but the abort still
-        gets its full RequestMetrics record and its own counter."""
+        Latency histograms only see stop/length retirements — an abort's (or
+        timeout's) wall time measures the client/deadline, not the engine —
+        but every retirement gets its full RequestMetrics record and its own
+        counter.  (The "rejected" counter is incremented at intake, where
+        the decision is made.)"""
         lc = self._lifecycles.pop(req.request_id, None)
         if lc is not None:
             lc.t_finish = self._now()
@@ -801,6 +980,10 @@ class LLMEngine:
                     (len(token_ids) - 1)
             if reason == "abort":
                 self._aborted_requests.inc()
+            elif reason == "timeout":
+                self._timeouts.inc()
+            elif reason == "rejected":
+                pass                    # counted at the intake decision
             else:
                 self._finished_requests.inc()
                 self._h_e2e.observe(lc.e2e_s)
@@ -863,9 +1046,13 @@ class LLMEngine:
         chunk0 = self._prefill_chunks.value
         self._step_dispatches = 0
         self._step_sync_s = 0.0
+        self._step_preempted = 0
         self._step_slots = {"decode": 0, "verify": 0, "chunk": 0}
         with self._span("engine.step"):
             self._harvest(finished)     # step n-1's tokens land first
+            if self._has_deadlines:
+                # right after harvest: bookkeeping is exact, nothing in flight
+                self._expire_deadlines(finished)
             with self._span("engine.admit"):
                 self._admit(finished)
             if self.fused:
@@ -876,14 +1063,21 @@ class LLMEngine:
                     # chunk program (cold path, next to the one-shot prefill)
                     self._prefill_tick(finished)
                     chunk_job = None
-                decode_batch = len(self._running)
                 if self._running or chunk_job is not None:
                     self._fused_iter(chunk_job, finished)
             else:
                 self._prefill_tick(finished)
-                decode_batch = len(self._running)
                 if self._running:
                     self._decode_iter(finished)
+            # decode-batch occupancy of what actually DISPATCHED: on a
+            # preemption step the pre-dispatch running count overstates the
+            # batch (victims left before the program ran)
+            decode_batch = self._step_slots["decode"] + \
+                self._step_slots["verify"]
+            # deferred swap-out fetches: the d2h lands while the device is
+            # busy with the dispatch above, not before it
+            if self._pending_d2h:
+                self._drain_swap_d2h()
         dur = self._now() - t0
         self._h_step.observe(dur)
         self._step_idx += 1
@@ -917,6 +1111,10 @@ class LLMEngine:
             "sync_ms": self._step_sync_s * 1e3,
             # per-mode slot occupancy of this step's decode-path dispatches
             "slots": dict(self._step_slots),
+            # overload lane (v2-additive): victims evicted this step and the
+            # live pool-pressure fraction the decision saw
+            "preempted": self._step_preempted,
+            "pool_pressure": round(mgr.pool_pressure(), 4),
         })
         return finished
 
@@ -936,7 +1134,7 @@ class LLMEngine:
         if not self._prefilling:
             return None
         slot, st = next(iter(self._prefilling.items()))
-        lp = st.request.prompt.size
+        lp = st.prompt.size
         n = min(self._chunk, lp - st.filled)
         job = {"slot": slot, "n": n, "q_offset": st.filled, "st": st,
                "done": st.filled + n == lp}
@@ -944,7 +1142,7 @@ class LLMEngine:
         self._prefill_chunks.inc()
         self._prefilled_tokens.inc(n)
         if self.prefix_cache:
-            self.cache.register_prefix(slot, st.request.prompt, st.filled)
+            self.cache.register_prefix(slot, st.prompt, st.filled)
         if job["done"]:
             del self._prefilling[slot]      # resolved at harvest
         return job
@@ -959,13 +1157,19 @@ class LLMEngine:
         immediately (double_buffer=False) or at the top of the next step."""
         mgr = self.cache
         B, T = mgr.num_slots, self._fused_T
-        if self._running:
-            self._decode_iters.inc()
         if self.spec_len and self._running:
             with self._span("engine.spec.propose"):
                 drafts = self._propose_drafts()
         else:
             drafts = {}
+        # optimistic admission: every running slot must own pages for the
+        # positions this dispatch writes — growth failures preempt victims
+        # out of self._running (and out of drafts) before the batch is built
+        self._grow_running(drafts)
+        if not self._running and chunk_job is None:
+            return                      # everything got preempted this step
+        if self._running:
+            self._decode_iters.inc()
         tokens = np.zeros((B, T), np.int32)
         valid = np.ones((B,), np.int32)
         qoff = np.zeros((B,), np.int32)
@@ -990,7 +1194,7 @@ class LLMEngine:
                 st = chunk_job["st"]
                 n = chunk_job["n"]
                 q0 = chunk_job["q_offset"]
-                tokens[slot, :n] = st.request.prompt[q0:q0 + n]
+                tokens[slot, :n] = st.prompt[q0:q0 + n]
                 valid[slot] = n
                 qoff[slot] = q0
                 greedy[slot] = self._req_greedy(st.request)
@@ -1053,7 +1257,10 @@ class LLMEngine:
                 st = cj["st"]
                 tok = int(out[cj["slot"], cj["n"] - 1])
                 self._start_decoding(st.request, cj["slot"], tok,
-                                     st.cached_tokens, finished)
+                                     st.cached_tokens, finished,
+                                     prompt_len=st.prompt.size,
+                                     prior=st.prior, ttft=st.ttft,
+                                     spec_off=st.spec_off, streak=st.streak)
 
     def _emit_slot(self, seq: _Running, slot: int, emitted: List[int],
                    nd: int, a: int, finished: List[RequestOutput]) -> bool:
@@ -1091,13 +1298,295 @@ class LLMEngine:
                 seq.spec_zero_streak = 0
         return self._maybe_finish(seq, finished)
 
+    # ---- oversubscription: growth, preemption, swap, deadlines ------------
+    def _grow_running(self, drafts: Dict[int, np.ndarray]) -> None:
+        """Optimistic admission's pre-dispatch capacity pass: every running
+        slot must own pages covering the positions this step will write
+        (its last token's KV at lengths, plus one slot per drafted
+        candidate).  A failed growth is THE preemption trigger: victims are
+        evicted until the growth fits, the growing slot itself last of all
+        (it re-queues at the head and replays later).  Runs strictly after
+        the step-top harvest, so no fused batch is in flight while page
+        state moves (the TPL007 discipline).  `drafts` is pruned of any slot
+        that got preempted.  Reservation mode returns immediately — every
+        slot's full footprint is already reserved."""
+        if not self.optimistic or not self._running:
+            return
+        forced = self._faults.pool_pressure(self._step_idx)
+        for slot in list(self._running):
+            while slot in self._running:
+                d = drafts.get(slot)
+                need = int(self.cache.lengths[slot]) + 1 + \
+                    (d.size if d is not None else 0)
+                try:
+                    if forced:
+                        forced = False
+                        raise RuntimeError("fault-injected pool pressure")
+                    self.cache.grow(slot, need)
+                    break
+                except RuntimeError:
+                    # the growing slot is a candidate too: if IT ranks worst
+                    # (lowest priority), preempting it both respects the
+                    # policy and resolves the failure — and alone it always
+                    # fits eventually (add_request rejected any footprint
+                    # larger than the pool), so its replay cannot loop
+                    self._preempt_slot(self._pick_victim())
+        for slot in list(drafts):
+            if slot not in self._running:
+                del drafts[slot]
+
+    def _pick_victim(self) -> int:
+        """The next preemption victim among ALL running slots: lowest
+        priority first, then most pages held (frees the most), least
+        progress (least work at stake), youngest last-arrived."""
+        return min(
+            self._running.items(),
+            key=lambda kv: (kv[1].request.priority,
+                            -self.cache.pages_held(kv[0]),
+                            len(kv[1].generated) /
+                            kv[1].request.max_new_tokens,
+                            -kv[1].request.request_id))[0]
+
+    def _preempt_slot(self, slot: int) -> None:
+        """Evict one running slot: bank its generation, park its KV (swap
+        mode, pool room permitting) or mark it for recompute, release its
+        pages, and re-queue it at the HEAD (preempted work outranks fresh
+        arrivals — starving a half-done request wastes the pages it already
+        burned)."""
+        seq = self._running.pop(slot)
+        req = seq.request
+        rid = req.request_id
+        mgr = self.cache
+        self._preemptions.inc()
+        self._step_preempted += 1
+        rec: Dict[str, object] = {
+            "rid": rid, "kind": "recompute",
+            "generated": list(seq.generated),
+            "cached_tokens": seq.cached_tokens, "ttft": seq.ttft_s,
+            "spec_off": seq.spec_off, "streak": seq.spec_zero_streak,
+        }
+        L = int(mgr.lengths[slot])
+        n = mgr.pages_needed(L)
+        if self.preempt == "swap" and \
+                mgr.swapped_page_count + n <= self.swap_pool_pages:
+            # gather the victim's pages into a standalone buffer NOW (the
+            # pages are about to be handed to a new owner); the blocking
+            # d2h fetch is deferred until after the next dispatch
+            ids = np.zeros((mgr.max_pages_per_slot,), np.int32)
+            ids[:n] = mgr.slot_pages(slot)[:n]
+            data = self._swap_out_fn(self._pool, self._h2d(ids))
+            self._swap_out_used = True
+            rec.update(kind="swap", L=L, n=n, k=data["k"], v=data["v"],
+                       fetched=False)
+            mgr.note_swap_out(rid, n)
+            self._pending_d2h.append(rec)
+            # swapped_pages/preempt_swaps count at d2h SUCCESS (in
+            # _materialize_swap): a copy that fails and degrades to
+            # recompute never delivered KV to the host pool, and the
+            # bench's swap-vs-recompute split must not claim it did
+        else:
+            self._preempt_recomputes.inc()
+        self._preempted[rid] = rec
+        lc = self._lifecycles.get(rid)
+        if lc is not None:
+            lc.preemptions += 1
+        mgr.release(slot)
+        self._free_slots.append(slot)
+        self._queue.appendleft(req)
+
+    def _materialize_swap(self, rec: Dict[str, object]) -> None:
+        """Fetch a swap record's gathered pages into host numpy (idempotent;
+        pads discarded).  Raises FaultInjected under an injected d2h
+        failure — the caller degrades the record to recompute."""
+        if rec.get("fetched"):
+            return
+        self._faults.d2h()
+        t0 = self._now()
+        with self._span("engine.swap.d2h"):
+            rec["k"] = np.asarray(rec["k"])[:, :rec["n"]]
+            rec["v"] = np.asarray(rec["v"])[:, :rec["n"]]
+        self._swap_ms_c.inc((self._now() - t0) * 1e3)
+        rec["fetched"] = True
+        self._swapped_pages_c.inc(rec["n"])
+        self._preempt_swaps.inc()
+
+    def _degrade_to_recompute(self, rec: Dict[str, object]) -> None:
+        """A swap whose d2h/h2d copy failed falls back to recompute: drop
+        the parked KV, clear the host-pool obligation, keep the banked
+        generation — nothing leaks, the replay just costs prefill again."""
+        rec["kind"] = "recompute"
+        rec.pop("k", None)
+        rec.pop("v", None)
+        self.cache.note_swap_in(rec["rid"])
+        self._preempt_recomputes.inc()
+
+    def _drain_swap_d2h(self) -> None:
+        """Materialize deferred swap-out fetches — called after the step's
+        dispatch so the d2h overlaps device compute instead of stalling the
+        schedule."""
+        while self._pending_d2h:
+            rec = self._pending_d2h.pop()
+            if rec["kind"] != "swap" or rec.get("fetched"):
+                continue            # consumed, degraded or dropped already
+            try:
+                self._materialize_swap(rec)
+            except FaultInjected:
+                self._degrade_to_recompute(rec)
+
+    def _drop_preempted(self, rid: int) -> Optional[Dict[str, object]]:
+        """Remove a resume record on abort/timeout, clearing any host swap
+        obligation; returns the record (its banked generation feeds the
+        output) or None."""
+        rec = self._preempted.pop(rid, None)
+        if rec is None:
+            return None
+        if rec["kind"] == "swap":
+            self.cache.note_swap_in(rid)
+            rec["kind"] = "dropped"     # _drain_swap_d2h skips it
+        return rec
+
+    def _swap_in(self, req: Request, rec: Dict[str, object],
+                 slot: int) -> bool:
+        """Restore a swapped victim into `slot`: allocate fresh pages for
+        its parked footprint and scatter the KV back in one h2d dispatch —
+        the request rejoins the decode set with NO prefill replay.  Returns
+        True when running again; False when it must keep waiting for pages
+        or was degraded to recompute (the caller re-examines the record)."""
+        rid = req.request_id
+        mgr = self.cache
+        try:
+            self._materialize_swap(rec)
+        except FaultInjected:
+            self._degrade_to_recompute(rec)
+            return False
+        try:
+            mgr.allocate(slot, rec["L"])
+        except RuntimeError:            # no pages yet — stay queued
+            return False
+        try:
+            self._faults.h2d()
+        except FaultInjected:
+            mgr.release(slot)
+            self._degrade_to_recompute(rec)
+            return False
+        n = rec["n"]
+        ids = np.zeros((mgr.max_pages_per_slot,), np.int32)
+        ids[:n] = mgr.slot_pages(slot)[:n]
+        k, v = rec["k"], rec["v"]
+        kd = np.zeros((k.shape[0], mgr.max_pages_per_slot) + k.shape[2:],
+                      k.dtype)
+        vd = np.zeros_like(kd)
+        kd[:, :n] = k
+        vd[:, :n] = v
+        t0 = self._now()
+        with self._span("engine.swap.h2d"):
+            self._pool = self._swap_in_fn(self._pool, self._h2d(ids),
+                                          self._h2d(kd), self._h2d(vd))
+        self._swap_in_used = True
+        self._swap_ms_c.inc((self._now() - t0) * 1e3)
+        mgr.note_swap_in(rid)
+        self._preempted.pop(rid)
+        mgr.lengths[slot] = rec["L"]
+        seq = _Running(req, slot, list(rec["generated"]),
+                       rec["cached_tokens"], rec["ttft"],
+                       self._req_greedy(req))
+        seq.spec_off = rec["spec_off"]
+        seq.spec_zero_streak = rec["streak"]
+        self._running[slot] = seq
+        return True
+
+    def _expire_deadlines(self, finished: List[RequestOutput]) -> None:
+        """Retire every request past its deadline, wherever it lives
+        (queued/swapped, prefilling, decoding), as finish_reason="timeout".
+        Runs right after the step-top harvest so page bookkeeping is exact;
+        injected clock skew (FaultPlan.skew) shifts only this evaluation.
+        Also re-derives `_has_deadlines` so an engine that served one
+        deadlined request long ago stops paying this scan once no
+        deadline-bearing request remains."""
+        now = self._now() + self._faults.skew()
+        live = False
+        for i in range(len(self._queue) - 1, -1, -1):
+            req = self._queue[i]
+            if req.deadline is not None and now >= req.deadline:
+                del self._queue[i]
+                rec = self._drop_preempted(req.request_id)
+                gen = list(rec["generated"]) if rec is not None else []
+                finished.append(self._finish_output(
+                    req, gen, "timeout",
+                    rec["cached_tokens"] if rec is not None else 0,
+                    rec["ttft"] if rec is not None else None))
+            elif req.deadline is not None:
+                live = True
+        for slot, st in list(self._prefilling.items()):
+            req = st.request
+            if req.deadline is not None and now >= req.deadline:
+                del self._prefilling[slot]
+                self.cache.release(slot)
+                self._free_slots.append(slot)
+                finished.append(self._finish_output(
+                    req, list(st.prior or []), "timeout",
+                    st.cached_tokens, st.ttft))
+            elif req.deadline is not None:
+                live = True
+        for slot, seq in list(self._running.items()):
+            req = seq.request
+            if req.deadline is not None and now >= req.deadline:
+                del self._running[slot]
+                self.cache.release(slot)
+                self._free_slots.append(slot)
+                finished.append(self._finish_output(
+                    req, seq.generated, "timeout", seq.cached_tokens,
+                    seq.ttft_s))
+            elif req.deadline is not None:
+                live = True
+        self._has_deadlines = live
+
     def _admit(self, finished: List[RequestOutput]) -> None:
         mgr = self.cache
         while self._queue and self._free_slots:
             req = self._queue[0]
-            total = req.prompt.size + req.max_new_tokens
-            tokens = req.prompt if self.prefix_cache else None
+            rid = req.request_id
             slot = self._free_slots[-1]
+            rec = self._preempted.get(rid)
+            if rec is not None and rec["kind"] == "swap":
+                # swap resume: one h2d scatter, no prefill replay
+                if self._swap_in(req, rec, slot):
+                    self._queue.popleft()
+                    self._free_slots.pop()
+                    continue
+                if rec["kind"] == "swap":
+                    break               # no pages yet — wait at the head
+                continue                # degraded to recompute: retry now
+            prior = list(rec["generated"]) if rec is not None else None
+            if prior:
+                # recompute resume: the banked generation is just a longer
+                # prompt — replayed through the prefix cache (its own pages
+                # are usually still indexed) and chunked prefill
+                prompt = np.concatenate(
+                    [req.prompt, np.asarray(prior, np.int32)])
+            else:
+                prompt = req.prompt
+            lp = prompt.size
+            remaining = req.max_new_tokens - len(prior or ())
+            # optimistic admission: reserve the PROMPT footprint only —
+            # decode growth allocates the rest token-granularly
+            total = lp if self.optimistic else lp + remaining
+            if self.optimistic and rec is None and \
+                    (self._running or self._prefilling) and \
+                    mgr.pages_needed(lp) + self._watermark > \
+                    mgr.num_free_pages + mgr.num_evictable_pages:
+                # vLLM-style admission watermark: a small GLOBAL headroom
+                # (~1% of the pool, >= 1 page) so a fresh admission cannot
+                # consume the very last page a running slot needs this step;
+                # beyond that, preemption — not admission control — is the
+                # pressure valve (a per-slot headroom would just re-create
+                # reservation admission with extra steps).  Only enforced
+                # while something is actually active: on an idle engine
+                # there is no slot to protect, and holding back a prompt
+                # whose footprint sits within the watermark of the whole
+                # pool would wedge the queue head forever
+                break
+            tokens = prompt if self.prefix_cache else None
             try:
                 # one shot: the prefix match and the reservation happen in the
                 # same call (a failed attempt rolls its sharing back), instead
@@ -1106,21 +1595,29 @@ class LLMEngine:
             except RuntimeError:            # out of KV pages
                 if not self._running and not self._prefilling and \
                         mgr.pages_in_use() == 0:
-                    # nothing will ever free: even with every cached prefix
-                    # evicted the footprint exceeds the pool
+                    # backstop (near-unreachable since add_request rejects
+                    # impossible footprints): nothing will ever free
                     raise ValueError(
-                        f"request {req.request_id} needs "
+                        f"request {rid} needs "
                         f"{mgr.pages_needed(total)} pages but the pool only "
                         f"has {mgr.num_pages - 1}; raise num_pages")
                 break                       # wait for pages to free up
             self._queue.popleft()
             self._free_slots.pop()
-            lc = self._lifecycles.get(req.request_id)
-            if lc is not None:
+            lc = self._lifecycles.get(rid)
+            if lc is not None and lc.t_admit is None:
                 lc.t_admit = self._now()
                 lc.queue_s = lc.t_admit - lc.t_enqueue
                 self._h_queue.observe(lc.queue_s)
                 lc.cached_tokens = matched
+            if rec is not None:
+                self._preempted.pop(rid)
+                self._recomputed_tokens.inc(lp - matched)
+            # resume-state fan-out, computed ONCE for both branches below
+            cached_out = rec["cached_tokens"] if rec is not None else matched
+            r_ttft = rec["ttft"] if rec is not None else None
+            r_spec_off = rec["spec_off"] if rec is not None else False
+            r_streak = rec["streak"] if rec is not None else 0
             if cow is not None:
                 # the matched partial page is shared: copy it into the slot's
                 # own page before anything is appended into it
@@ -1133,12 +1630,11 @@ class LLMEngine:
             if matched:
                 self._prefix_cached_tokens.inc(matched)
                 self._prefix_hit_requests.inc()
-            lp = req.prompt.size
             if not self.chunked and matched == 0:
                 # legacy one-shot bucketed prefill, synchronous at admission
                 bucket = self._bucket_for(lp)
                 ids = np.zeros((1, bucket), np.int32)
-                ids[0, :lp] = req.prompt
+                ids[0, :lp] = prompt
                 pages = row[:bucket // mgr.page_size][None, :]
                 with self._span("engine.prefill.dispatch"):
                     first, self._pool, self._key = self._prefill_fn(
@@ -1148,15 +1644,20 @@ class LLMEngine:
                 self._seen_buckets.add(bucket)
                 self._prefilled_tokens.inc(lp)
                 if self.prefix_cache:
-                    mgr.register_prefix(slot, req.prompt, lp)
+                    mgr.register_prefix(slot, prompt, lp)
                 t_sync = self._now()
                 with self._span("engine.sample.sync"):
                     first = int(np.asarray(first)[0])   # blocks on the result
                 self._step_sync_s += self._now() - t_sync
-                self._start_decoding(req, slot, first, 0, finished)
+                self._start_decoding(
+                    req, slot, first, cached_out, finished, prompt_len=lp,
+                    prior=prior, ttft=r_ttft, spec_off=r_spec_off,
+                    streak=r_streak)
             else:
-                self._prefilling[slot] = _Prefilling(req, slot, matched,
-                                                     matched)
+                self._prefilling[slot] = _Prefilling(
+                    req, slot, matched, cached_out, prompt=prompt,
+                    prior=prior, ttft=r_ttft, spec_off=r_spec_off,
+                    streak=r_streak)
 
     def _prefill_tick(self, finished: List[RequestOutput]) -> None:
         """Advance the oldest admitted prompt by ONE chunk through the
@@ -1169,11 +1670,11 @@ class LLMEngine:
             return
         slot, st = next(iter(self._prefilling.items()))
         mgr = self.cache
-        lp = st.request.prompt.size
+        lp = st.prompt.size
         C = self._chunk
         n = min(C, lp - st.filled)
         ids = np.zeros((1, C), np.int32)
-        ids[0, :n] = st.request.prompt[st.filled:st.filled + n]
+        ids[0, :n] = st.prompt[st.filled:st.filled + n]
         with self._span("engine.prefill.dispatch"):
             tok, self._pool, self._key = self._chunk_fn(
                 self.params, self._h2d(ids), self._pool,
@@ -1188,7 +1689,7 @@ class LLMEngine:
         self._prefilled_tokens.inc(n)
         st.filled += n
         if self.prefix_cache:
-            mgr.register_prefix(slot, st.request.prompt, st.filled)
+            mgr.register_prefix(slot, st.prompt, st.filled)
         if st.filled == lp:
             del self._prefilling[slot]
             t_sync = self._now()
@@ -1196,21 +1697,38 @@ class LLMEngine:
                 tok = int(np.asarray(tok)[0])           # blocks on the result
             self._step_sync_s += self._now() - t_sync
             self._start_decoding(st.request, slot, tok, st.cached_tokens,
-                                 finished)
+                                 finished, prompt_len=lp, prior=st.prior,
+                                 ttft=st.ttft, spec_off=st.spec_off,
+                                 streak=st.streak)
 
     def _start_decoding(self, req: Request, slot: int, first: int,
-                        cached: int, finished: List[RequestOutput]) -> None:
-        """Prompt fully in pages + first token picked: join the decode set."""
-        self.cache.lengths[slot] = req.prompt.size
+                        cached: int, finished: List[RequestOutput],
+                        prompt_len: Optional[int] = None,
+                        prior: Optional[List[int]] = None,
+                        ttft: Optional[float] = None,
+                        spec_off: bool = False, streak: int = 0) -> None:
+        """Prompt fully in pages + first token picked: join the decode set.
+        A recompute resume passes the EFFECTIVE prompt length (original +
+        banked generation in pages) and its `prior` tokens — the new `first`
+        token continues that stream, and TTFT/back-off state carry over from
+        before the preemption instead of being re-stamped."""
+        self.cache.lengths[slot] = \
+            req.prompt.size if prompt_len is None else prompt_len
         now = self._now()
-        ttft = now - req.t_enqueue
         lc = self._lifecycles.get(req.request_id)
-        if lc is not None:
-            lc.t_first_token = now
-            lc.ttft_s = ttft
-        self._h_ttft.observe(ttft)
-        seq = _Running(req, slot, [first], cached, ttft,
+        if prior:
+            generated = list(prior) + [first]
+        else:
+            generated = [first]
+            ttft = now - req.t_enqueue
+            if lc is not None:
+                lc.t_first_token = now
+                lc.ttft_s = ttft
+            self._h_ttft.observe(ttft)
+        seq = _Running(req, slot, generated, cached, ttft,
                        self._req_greedy(req))
+        seq.spec_off = spec_off
+        seq.spec_zero_streak = streak
         if not self._maybe_finish(seq, finished):
             self._running[slot] = seq
 
@@ -1220,12 +1738,15 @@ class LLMEngine:
         (undrafted ones at valid=1 — plain decode through the same program)
         and sampled slots fall back to the vanilla decode executable in the
         same iteration; otherwise everything takes the vanilla path."""
-        self._decode_iters.inc()
         if self.spec_len:
             with self._span("engine.spec.propose"):
                 drafts = self._propose_drafts()
         else:
             drafts = {}
+        self._grow_running(drafts)
+        if not self._running:
+            return                      # everything got preempted this step
+        self._decode_iters.inc()
         if drafts:
             self._verify_iter(drafts, finished)
             rest = [s for s, seq in self._running.items() if not seq.greedy]
@@ -1405,6 +1926,26 @@ class LLMEngine:
                 self._key, self._h2d(np.zeros((B,), bool)))
         self._decode_used = True
 
+    def warm_swap(self) -> None:
+        """Compile the preemption swap gather/scatter against null-page ids
+        (all content lands on the never-read page 0) — benches call this in
+        warmup so an oversubscribed run's first preemption doesn't pay a
+        compile inside the timed section.  No-op unless the engine can
+        actually swap (optimistic admission + preempt="swap")."""
+        if not (self.optimistic and self.preempt == "swap"):
+            return
+        mgr = self.cache
+        ids = np.zeros((mgr.max_pages_per_slot,), np.int32)
+        data = self._swap_out_fn(self._pool, self._h2d(ids))
+        self._swap_out_used = True
+        # round-trip through host numpy so the swap-in signature matches the
+        # real resume path (replicated staging uploads, not device outputs)
+        kd = np.asarray(data["k"])
+        vd = np.asarray(data["v"])
+        self._pool = self._swap_in_fn(self._pool, self._h2d(ids),
+                                      self._h2d(kd), self._h2d(vd))
+        self._swap_in_used = True
+
     def _maybe_finish(self, seq: _Running,
                       finished: List[RequestOutput]) -> bool:
         reason = None
@@ -1421,6 +1962,17 @@ class LLMEngine:
                                   seq.cached_tokens, seq.ttft_s)
         finished.append(out)
         return True
+
+    def swap_pool_bytes(self) -> int:
+        """Worst-case HOST memory the swap pool may hold (the declared
+        bound `swap_pool_pages` times the k+v bytes of one page across all
+        layers) — the number `tools/tpu_cost.py` audits against
+        `SERVE_RESOURCE_BUDGET["swap_pool_bytes"]`.  Occupancy is the
+        `kv_pages_swapped` gauge; this is the ceiling."""
+        k = self._pool["k"]         # [L, P, page, KVH, hd]
+        per_page = 2 * int(np.prod([k.shape[0], *k.shape[2:]])) * \
+            np.dtype(k.dtype).itemsize
+        return self.swap_pool_pages * per_page
 
     def run(self) -> Dict[int, RequestOutput]:
         """Drain the queue: step until every request completes.  Returns
@@ -1508,6 +2060,10 @@ class LLMEngine:
                                           1 if self._chunk_used else 0)),
             "copy_executables": execs(self._copy_fn,
                                       1 if self._copy_used else 0),
+            "swap_executables": execs(self._swap_out_fn,
+                                      1 if self._swap_out_used else 0) +
+                                execs(self._swap_in_fn,
+                                      1 if self._swap_in_used else 0),
             "buckets": list(self.buckets),
             "prefill_chunk": self.prefill_chunk,
             "spec_len": self.spec_len,
@@ -1545,6 +2101,21 @@ class LLMEngine:
             "running": len(self._running),
             "finished_requests": self._finished_requests.value,
             "aborted_requests": self._aborted_requests.value,
+            # overload surface: admission/preempt modes + the counters the
+            # oversubscription bench and dashboards consume
+            "admission": self.admission,
+            "preempt": self.preempt,
+            "preemptions": self._preemptions.value,
+            "preempt_swaps": self._preempt_swaps.value,
+            "preempt_recomputes": self._preempt_recomputes.value,
+            "swapped_pages": self._swapped_pages_c.value,
+            "swap_ms": self._swap_ms_c.value,
+            "recomputed_tokens": self._recomputed_tokens.value,
+            "timeouts": self._timeouts.value,
+            "rejected_requests": self._rejected_requests.value,
+            "swapped": self.cache.swapped_requests,
+            "kv_pages_swapped": self.cache.swapped_page_count,
+            "kv_pool_pressure": round(self.cache.pool_pressure(), 4),
             # latency distributions (engine-side histograms; seconds) — the
             # serving SLO surface: benches report p50/p99 straight from here
             "latency": {

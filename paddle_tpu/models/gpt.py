@@ -1059,6 +1059,31 @@ def serve_step_paged(params, tokens, cache, page_table, q_offset, valid,
     return out, accept, cache, key
 
 
+def swap_out_pages(cache, page_ids):
+    """Preemption swap-out gather (vLLM-style KV swapping): copy the victim's
+    pages out of the pool into a standalone device buffer the host then
+    fetches at its leisure — the gather is a fresh buffer, so the pool pages
+    can be handed to a new owner immediately and the d2h overlaps the next
+    decode dispatch.
+
+    cache {"k","v"} [L, P, page, KVH, hd]; page_ids [max_pages] int32 — the
+    victim's pages PADDED to the slot capacity with the null page 0, so ONE
+    fixed-shape executable serves every victim (padding rows carry null-page
+    garbage the host discards).  Returns {"k","v"} [L, max_pages, page, KVH,
+    hd]."""
+    return {n: a[:, page_ids] for n, a in cache.items()}
+
+
+def swap_in_pages(cache, page_ids, k, v):
+    """Preemption swap-in scatter: restore a previously swapped victim's KV
+    into its freshly allocated pages.  page_ids is padded with the null page
+    0 exactly like `swap_out_pages` — padding rows scatter zeros into page 0,
+    which is written by every inactive slot anyway and never read.  The pool
+    arrives donated (in-place restore); returns the updated cache."""
+    return {"k": cache["k"].at[:, page_ids].set(k),
+            "v": cache["v"].at[:, page_ids].set(v)}
+
+
 # LRU-bounded executable cache for `generate` (unbounded it leaks one compiled
 # program per (config, B, Tp, max_new, sampling) combination — a real leak
 # under varied prompt shapes; the serving engine bounds shapes by bucketing

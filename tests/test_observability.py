@@ -406,6 +406,12 @@ NEW_STATS_KEYS = frozenset({
     # added by the observability PR
     "engine_steps", "spec_events", "finished_requests", "aborted_requests",
     "latency",
+}) | frozenset({
+    # added by the oversubscription PR (overload surface)
+    "swap_executables", "admission", "preempt", "preemptions",
+    "preempt_swaps", "preempt_recomputes", "swapped_pages", "swap_ms",
+    "recomputed_tokens", "timeouts", "rejected_requests", "swapped",
+    "kv_pages_swapped", "kv_pool_pressure",
 })
 
 

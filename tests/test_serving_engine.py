@@ -158,14 +158,18 @@ def test_engine_queues_when_out_of_pages():
 
 
 def test_engine_rejects_impossible_footprint():
-    """A request that can never fit the pool raises instead of livelocking."""
+    """A request that can never fit the pool is rejected AT INTAKE
+    (finish_reason="rejected") instead of raising mid-run or wedging the
+    queue head forever — the fail-fast side of the overload work (see
+    tests/test_overload.py for the not-wedged proof)."""
     cfg = G.gpt_tiny(64)
     params = G.init_params(cfg, jax.random.key(0))
     eng = LLMEngine(params, cfg, num_slots=2, page_size=8, num_pages=3,
                     max_model_len=64)      # 2 real pages = 16 tokens capacity
-    eng.add_request(np.zeros((20,), np.int32), max_new_tokens=8)
-    with pytest.raises(ValueError, match="raise num_pages"):
-        eng.run()
+    rid = eng.add_request(np.zeros((20,), np.int32), max_new_tokens=8)
+    assert not eng.has_work                # never queued
+    assert eng.run()[rid].finish_reason == "rejected"
+    assert eng.stats()["rejected_requests"] == 1
 
 
 def test_engine_non_pow2_max_model_len_served_to_capacity():

@@ -317,6 +317,28 @@ def test_tpl007_flags_mutation_before_harvest(tmp_path):
         and "Engine.abort" in fs[0].message
 
 
+def test_tpl007_flags_preempt_before_harvest(tmp_path):
+    # the oversubscription PR's hazard shape: a public preempt entry point
+    # that releases a victim's pages and hands them to a new owner while the
+    # double-buffered batch is still in flight — the in-flight harvest would
+    # then apply step-n results to step-n+1 page ownership.  (The real
+    # engine's preemption runs inside step(), strictly after the step-top
+    # harvest, so it passes by construction.)
+    fs = lint_snippet(tmp_path, """
+        class Engine:
+            def _dispatch(self):
+                self._inflight = {"out": 1}
+
+            def _harvest(self, finished):
+                self._inflight = None
+
+            def preempt_request(self, slot):
+                self.cache.release(slot)        # victim pages freed...
+                self.cache.allocate(slot, 8)    # ...and reassigned, unharvested
+    """, rule="TPL007")
+    assert len(fs) == 1 and "Engine.preempt_request" in fs[0].message
+
+
 def test_tpl007_silent_when_harvested_first(tmp_path):
     # the exact shape LLMEngine.abort/step use: harvest (or a guarded
     # harvest) strictly before the first page-state mutation, including

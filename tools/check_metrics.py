@@ -36,7 +36,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 REQUIRED_STATS_KEYS = frozenset({
     "decode_executables", "verify_executables", "prefill_executables",
-    "copy_executables", "buckets", "prefill_chunk", "spec_len", "mp",
+    "copy_executables", "swap_executables", "buckets", "prefill_chunk",
+    "spec_len", "mp",
     "engine_steps", "decode_iterations", "decode_tokens", "verify_steps",
     "spec_events", "spec_drafted_tokens", "spec_accepted_tokens",
     "spec_emitted_tokens", "spec_backoffs", "accepted_per_step",
@@ -45,6 +46,12 @@ REQUIRED_STATS_KEYS = frozenset({
     "pages_in_use", "pages_free", "pages_evictable", "prefix_evictions",
     "kv_token_capacity", "dense_token_footprint", "queued", "prefilling",
     "running", "finished_requests", "aborted_requests", "latency",
+    # overload surface (oversubscription PR): admission/preempt modes + the
+    # preemption/swap/deadline counters the bench and dashboards consume
+    "admission", "preempt", "preemptions", "preempt_swaps",
+    "preempt_recomputes", "swapped_pages", "swap_ms", "recomputed_tokens",
+    "timeouts", "rejected_requests", "swapped", "kv_pages_swapped",
+    "kv_pool_pressure",
 })
 REQUIRED_LATENCY_KEYS = frozenset(
     {"queue_s", "ttft_s", "tpot_s", "e2e_s", "step_s"})
@@ -54,10 +61,13 @@ REQUIRED_COUNTERS = frozenset({
     "cow_page_copies", "verify_steps", "spec_events", "spec_drafted_tokens",
     "spec_accepted_tokens", "spec_emitted_tokens", "spec_backoffs",
     "finished_requests", "aborted_requests", "prefix_evictions",
+    "preemptions", "preempt_swaps", "preempt_recomputes", "swapped_pages",
+    "swap_ms", "recomputed_tokens", "timeouts", "rejected_requests",
 })
 REQUIRED_GAUGES = frozenset({
     "queued", "prefilling", "running", "kv_pages_in_use", "kv_pages_free",
-    "kv_pages_evictable", "prefix_cached_pages",
+    "kv_pages_evictable", "prefix_cached_pages", "kv_pages_swapped",
+    "kv_pool_pressure",
 })
 REQUIRED_HISTOGRAMS = frozenset({
     "queue_time_seconds", "ttft_seconds", "tpot_seconds",

@@ -12,7 +12,10 @@ cannot silently reintroduce per-shape recompiles:
 - prefill-side (chunked mode): <= 2 programs for the cold paths (the chunk
   rides the fused batch, so a chunked fused run measures 0);
 - copy: <= 1 program (the COW page copy);
-- total: <= 4.
+- swap: <= 2 programs (the preemption KV swap-out gather + swap-in scatter,
+  compiled only when `preempt="swap"` actually preempts — 0 on this
+  reservation-mode stream);
+- total: <= 6.
 
 The budget holds PER MESH CONFIG: a second pass re-measures under mp=2
 tensor-parallel serving (8 forced CPU host devices — the same simulation the
@@ -65,10 +68,15 @@ def measure(mp=1):
                                    stats["verify_executables"],
         "prefill_executables": stats["prefill_executables"],
         "copy_executables": stats["copy_executables"],
+        # preemption swap gather/scatter: 0 on this reservation-mode stream
+        # (they compile only when preempt="swap" actually fires), bounded
+        # <= 2 by the declared budget either way
+        "swap_executables": stats["swap_executables"],
     }
     got["total_executables"] = (got["decode_side_executables"] +
                                 got["prefill_executables"] +
-                                got["copy_executables"])
+                                got["copy_executables"] +
+                                got["swap_executables"])
     return got, stats
 
 
