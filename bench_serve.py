@@ -87,6 +87,7 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
                     prefill_chunk=None, prefix_cache=True,
                     shared_prefix_frac=0.0, spec_len=0, mp=1, fuse=True,
                     oversubscribe=0.0, preempt="recompute",
+                    weight_dtype=None, kv_dtype=None,
                     trace_dir=None):
     """Replay a Poisson request stream through LLMEngine; returns the metrics
     dict (also the CI smoke entrypoint — tests assert on the executable
@@ -110,7 +111,16 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
     recompute.  The JSON then carries preemptions/step, the swap-vs-
     recompute split and `goodput_tokens_per_sec` (tokens in FINAL outputs
     per second — replayed prefill work earns nothing), and the page/swap
-    accounting is invariant-checked at drain."""
+    accounting is invariant-checked at drain.
+
+    weight_dtype/kv_dtype ("int8" or None/"bf16") run the engine quantized
+    (weight-only int8 params / int8 KV page pool).  Under oversubscribe an
+    int8 KV pool is sized to the SAME HBM byte budget as the fp pool would
+    get — smaller pages mean proportionally more of them, which is exactly
+    the capacity claim under test: the quantized pass should preempt less
+    at the same byte pressure.  The returned `output_tokens` (per-request
+    generated streams, request-id order) let main() report the top-1
+    agreement rate of a quantized pass against its fp baseline."""
     import hashlib
     import math
 
@@ -118,6 +128,11 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
 
     from paddle_tpu.inference.engine import LLMEngine
     from paddle_tpu.models import gpt as gpt_mod
+    from paddle_tpu.quantization.serving import (kv_page_bytes,
+                                                 normalize_quant_dtype)
+
+    weight_dtype = normalize_quant_dtype(weight_dtype, "weight_dtype")
+    kv_dtype = normalize_quant_dtype(kv_dtype, "kv_dtype")
 
     if config is None:
         config = gpt_mod.gpt_tiny(128)
@@ -171,12 +186,21 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
                       for p in prompts)
         num_pages = max(need, biggest + 1) + 1      # +1: the null page
         num_slots = max(num_slots, num_requests)
+        if kv_dtype == "int8":
+            # equal-BYTE pool sizing: the fp pass's pool bytes at this F,
+            # refilled with smaller int8 pages — the capacity win the
+            # quantized pass must demonstrate (fewer preemptions at the
+            # same HBM budget), reported as preemptions_per_step delta
+            ratio = kv_page_bytes(config, page_size) / \
+                kv_page_bytes(config, page_size, "int8")
+            num_pages = int((num_pages - 1) * ratio) + 1
 
     eng = LLMEngine(params, config, num_slots=num_slots, page_size=page_size,
                     num_pages=num_pages,
                     max_model_len=max_model_len, prefill_chunk=prefill_chunk,
                     prefix_cache=prefix_cache, spec_len=spec_len, fuse=fuse,
                     admission=admission, preempt=preempt,
+                    weight_dtype=weight_dtype, kv_dtype=kv_dtype,
                     mp=mp if mp and mp > 1 else None,
                     trace_ring=4096)    # ring must hold the whole timed run
                                         # for the dispatches/sync aggregates
@@ -291,6 +315,14 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
     return {
         "mp": eng.mp,
         "fused": eng.fused,
+        # quantized-serving surface: knobs, at-rest pool bytes (the capacity
+        # number) and the per-request streams main() scores agreement on
+        "weight_dtype": st["weight_dtype"],
+        "kv_dtype": st["kv_dtype"],
+        "kv_pool_bytes": st["kv_pool_bytes"],
+        "intake_swap_rejects": st["intake_swap_rejects"],
+        "output_tokens": [list(map(int, o.token_ids))
+                          for o in sorted(outs, key=lambda o: o.request_id)],
         "dispatches_per_step": round(dispatches_per_step, 3),
         "host_sync_ms_per_step": round(host_sync_ms, 4),
         "predicted_step_ms": round(predicted_ms, 4),
@@ -399,6 +431,22 @@ def main():
                          "also runs an unpressured comparison pass "
                          "reporting goodput_ratio + byte-exact "
                          "oversubscribe_parity")
+    ap.add_argument("--weight-dtype", choices=("bf16", "int8"),
+                    default="bf16",
+                    help="serving param dtype: int8 = weight-only symmetric "
+                         "per-channel PTQ (dequantized per block inside the "
+                         "layer scan; at-rest param HBM drops ~2x vs bf16, "
+                         "~4x vs fp32); also runs an fp comparison pass on "
+                         "the same stream reporting top-1 agreement")
+    ap.add_argument("--kv-dtype", choices=("bf16", "int8"),
+                    default="bf16",
+                    help="KV page pool dtype: int8 = quantized pages + "
+                         "per-token scale lanes, dequantized per page on "
+                         "read inside the paged-attention kernels; under "
+                         "--oversubscribe the int8 pool is sized to the "
+                         "SAME HBM bytes (more pages), so the capacity win "
+                         "shows as the preemptions_per_step delta vs the fp "
+                         "comparison pass")
     ap.add_argument("--preempt", choices=("recompute", "swap"),
                     default="recompute",
                     help="preemption mechanism under --oversubscribe: "
@@ -464,15 +512,35 @@ def main():
                   else args.request_rate)
         metric = "serve_decode_tokens_per_sec (cpu smoke)"
     fuse = not args.no_fuse
+    quant = dict(weight_dtype=args.weight_dtype, kv_dtype=args.kv_dtype)
     stats = run_serve_bench(spec_len=spec_len, fuse=fuse,
-                            trace_dir=args.trace_dir, **kw)
+                            trace_dir=args.trace_dir, **quant, **kw)
+    if args.weight_dtype == "int8" or args.kv_dtype == "int8":
+        # fp comparison on the SAME stream: the quantized pass's capacity
+        # win (kv_pool_bytes, preemptions/step at the same byte budget) and
+        # its accuracy price (top-1 token agreement — weight-only int8 +
+        # int8 KV is a lossy approximation, so the bar is a rate, not the
+        # byte parity every fp A/B in this bench holds itself to)
+        base = run_serve_bench(spec_len=spec_len, fuse=fuse, **kw)
+        total = agree = 0
+        for qt, ft in zip(stats["output_tokens"], base["output_tokens"]):
+            total += max(len(qt), len(ft))
+            agree += sum(int(a == b) for a, b in zip(qt, ft))
+        stats["fp_kv_pool_bytes"] = base["kv_pool_bytes"]
+        stats["kv_pool_bytes_ratio"] = round(
+            base["kv_pool_bytes"] / max(stats["kv_pool_bytes"], 1), 3)
+        stats["fp_goodput_tokens_per_sec"] = base["goodput_tokens_per_sec"]
+        stats["fp_preemptions_per_step"] = base["preemptions_per_step"]
+        stats["preemptions_per_step_delta"] = round(
+            stats["preemptions_per_step"] - base["preemptions_per_step"], 4)
+        stats["top1_agreement"] = round(agree / max(total, 1), 4)
     if args.oversubscribe > 0:
         # unpressured comparison on the SAME stream at F=1 (pool capacity ==
         # submitted footprint, same slot count and machinery, no pressure):
         # preemption must cost throughput, not tokens — greedy outputs
         # byte-identical, goodput_ratio the honest price of running F x
         # oversubscribed
-        base = run_serve_bench(spec_len=spec_len, fuse=fuse,
+        base = run_serve_bench(spec_len=spec_len, fuse=fuse, **quant,
                                **dict(kw, oversubscribe=1.0))
         stats["unpressured_goodput_tokens_per_sec"] = \
             base["goodput_tokens_per_sec"]
@@ -485,7 +553,7 @@ def main():
         # spec on/off delta on the SAME stream: greedy acceptance is lossless,
         # so the digests must match and the tokens/s ratio is the honest win
         # (comparison pass untraced: tracing overhead must not skew the ratio)
-        base = run_serve_bench(spec_len=0, fuse=fuse, **kw)
+        base = run_serve_bench(spec_len=0, fuse=fuse, **quant, **kw)
         stats["no_spec_decode_tokens_per_sec_per_chip"] = \
             base["decode_tokens_per_sec_per_chip"]
         stats["spec_speedup"] = round(
@@ -499,7 +567,8 @@ def main():
         # the dispatch win shows as dispatches_per_step 1.0 vs up to 3 plus
         # the tokens/s ratio (on TPU the dispatch overhead is the payoff; on
         # CPU the bar is "no regression")
-        unfused = run_serve_bench(spec_len=spec_len, fuse=False, **kw)
+        unfused = run_serve_bench(spec_len=spec_len, fuse=False, **quant,
+                                  **kw)
         stats["no_fuse_decode_tokens_per_sec_per_chip"] = \
             unfused["decode_tokens_per_sec_per_chip"]
         stats["no_fuse_dispatches_per_step"] = \
@@ -509,6 +578,9 @@ def main():
             max(unfused["decode_tokens_per_sec_per_chip"], 1e-9), 3)
         stats["fuse_parity"] = \
             stats["outputs_digest"] == unfused["outputs_digest"]
+    # per-request streams fed the agreement score above; the digest already
+    # fingerprints them, so keep the JSON line bounded
+    stats.pop("output_tokens", None)
     print(json.dumps({"metric": metric,
                       "value": stats["decode_tokens_per_sec_per_chip"],
                       "unit": "tokens/s/chip", **stats}))

@@ -18,8 +18,11 @@ Two levels, one CLI (`tools/tpu_lint.py`):
   static HBM/collective/roofline accounts over the same serving executables
   — at-rest sharded/replicated/pool bytes per device (JXP006 replicated
   ceiling), donation-aware jaxpr-liveness peak (JXP008), collective
-  bytes/step from the optimized HLO (JXP007), and a bytes/flops roofline —
-  against `registry.SERVE_RESOURCE_BUDGET`.
+  bytes/step from the optimized HLO (JXP007), the host swap-pool bound
+  (JXP009, fp + int8), and a bytes/flops roofline — against
+  `registry.SERVE_RESOURCE_BUDGET`.  The quantized serving engine
+  (weight/kv int8) is accounted each pass against its own declared
+  yardstick (tightened replicated ceiling, pool-shrink floor — JXP010).
 """
 from __future__ import annotations
 

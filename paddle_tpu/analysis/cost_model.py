@@ -773,7 +773,8 @@ def run_cost_checks(include_mp: bool = True, mp: int = 2,
     findings)."""
     import jax
 
-    from .jaxpr_checks import _build_engine, serving_targets
+    from .jaxpr_checks import (_build_engine, quantized_targets,
+                               serving_targets)
     from . import registry
 
     if budget is None:
@@ -806,9 +807,59 @@ def run_cost_checks(include_mp: bool = True, mp: int = 2,
                 f"declared swap_pool_bytes budget {swap_cap} — size "
                 f"swap_pool_pages down or raise the budget with the host "
                 f"memory math that justifies it"))
+        # ---- quantized serving pass (ISSUE-11): the int8 engine at the
+        # SAME pool geometry, audited against its own declared yardstick —
+        # the quantization win must show up here before any TPU run -------
+        qeng, _ = _build_engine(m, weight_dtype="int8", kv_dtype="int8")
+        q_at_rest = engine_at_rest(qeng)
+        q_budget = dict(budget)
+        q_ceiling = budget.get("replicated_bytes_ceiling_int8")
+        if q_ceiling is not None:
+            # tightened JXP006 ceiling for the quantized engine: a fp-width
+            # embedding re-materializing in the quantized at-rest account
+            # is a regression the fp ceiling would never see
+            q_budget["replicated_bytes_ceiling"] = q_ceiling
+        q_costs, q_fs = audit_resources(
+            quantized_targets(m, engine=qeng), q_at_rest, q_budget)
+        findings.extend(q_fs)
+        costs.extend(q_costs)
+        pool_ratio = at_rest.pool_bytes / max(q_at_rest.pool_bytes, 1)
+        min_ratio = budget.get("quantized_pool_min_ratio")
+        if min_ratio is not None and pool_ratio < min_ratio:
+            findings.append(Finding(
+                "JXP010", "<at-rest>", 0, 0,
+                f"int8 KV pool at-rest bytes shrink only {pool_ratio:.2f}x "
+                f"vs the fp pool at the same geometry (declared floor "
+                f"{min_ratio}x) — the quantized pool stopped paying for "
+                f"itself (a scale lane widened, or pages re-materialized at "
+                f"fp width)"))
+        q_pool_cap = budget.get("quantized_pool_bytes")
+        if q_pool_cap is not None and q_at_rest.pool_bytes > q_pool_cap:
+            findings.append(Finding(
+                "JXP010", "<at-rest>", 0, 0,
+                f"int8 KV pool at-rest bytes {q_at_rest.pool_bytes} exceed "
+                f"the declared quantized_pool_bytes budget {q_pool_cap}"))
+        if q_at_rest.param_bytes_replicated >= at_rest.param_bytes_replicated:
+            findings.append(Finding(
+                "JXP010", "<at-rest>", 0, 0,
+                f"int8 weights do not reduce the replicated param account "
+                f"({q_at_rest.param_bytes_replicated} vs fp "
+                f"{at_rest.param_bytes_replicated} bytes) — the quantized "
+                f"wte/head is not actually stored int8"))
+        q_swap_cap = budget.get("swap_pool_bytes_int8")
+        q_swap_bytes = qeng.swap_pool_bytes()
+        if q_swap_cap is not None and q_swap_bytes > q_swap_cap:
+            findings.append(Finding(
+                "JXP009", "<at-rest>", 0, 0,
+                f"int8 host swap pool bound {q_swap_bytes} bytes exceeds "
+                f"the declared swap_pool_bytes_int8 budget {q_swap_cap} — "
+                f"int8 pages must swap as int8, not re-widened fp"))
         reports[m] = {
             "at_rest": at_rest.to_json(),
+            "at_rest_quantized": q_at_rest.to_json(),
+            "quantized_pool_ratio": round(pool_ratio, 3),
             "swap_pool_bytes": swap_bytes,
+            "swap_pool_bytes_int8": q_swap_bytes,
             # predicted_ms computed HERE through ProgramCost.predicted_ms so
             # the CLI report and the bench JSON share one roofline formula
             "programs": [dict(c.to_json(),

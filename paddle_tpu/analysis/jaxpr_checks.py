@@ -35,7 +35,9 @@ default fused engine AND the `fuse=False` legacy trio, so the `--no-fuse`
 escape hatch stays audited) and checks the real serving set — fused step,
 legacy decode/chunk/verify, bucketed prefill, COW copy, and the two
 preemption KV-swap copies (swap-out gather / swap-in scatter) — plus an
-mp=2 pass when enough devices exist.
+mp=2 pass when enough devices exist.  The quantized serving engine's fused
+step (`quantized_targets`, weight/kv int8) rides the same audit so dequant
+cannot smuggle a transfer/upcast/logits-fetch into the one-dispatch step.
 """
 from __future__ import annotations
 
@@ -242,7 +244,8 @@ def audit_jaxpr(name: str, fn, args, *, donate_paths: Sequence[str] = (),
 # ---------------------------------------------------------------------------
 
 
-def _build_engine(mp: int, fuse: bool = True):
+def _build_engine(mp: int, fuse: bool = True, weight_dtype=None,
+                  kv_dtype=None):
     import jax
 
     from ..inference.engine import LLMEngine
@@ -252,6 +255,7 @@ def _build_engine(mp: int, fuse: bool = True):
     params = gpt_mod.init_params(cfg, jax.random.key(0))
     return LLMEngine(params, cfg, num_slots=2, page_size=8, max_model_len=64,
                      prefill_chunk=8, spec_len=2, fuse=fuse,
+                     weight_dtype=weight_dtype, kv_dtype=kv_dtype,
                      mp=mp if mp > 1 else None), cfg
 
 
@@ -326,11 +330,39 @@ def serving_targets(mp: int = 1, engines=None
          dict(keep_paths=("arg0",), **mp_kw)),
         (f"serve.{tag}swap_in", unwrap(eng._swap_in_fn),
          (eng._pool, jnp.zeros((P,), i32),
-          jnp.zeros((cfgL, P) + eng._pool["k"].shape[2:],
-                    eng._pool["k"].dtype),
-          jnp.zeros((cfgL, P) + eng._pool["k"].shape[2:],
-                    eng._pool["k"].dtype)),
+          {n: jnp.zeros((cfgL, P) + a.shape[2:], a.dtype)
+           for n, a in eng._pool.items()}),
          dict(donate_paths=("arg0",), **mp_kw)),
+    ]
+
+
+def quantized_targets(mp: int = 1, engine=None
+                      ) -> List[Tuple[str, object, tuple, dict]]:
+    """The int8 serving engine's fused step as an audit target: same JXP001-
+    005 discipline as the fp fused step (pool donated, params kept, O(B*K)
+    int host output) over a weight_dtype=kv_dtype="int8" engine — dequant
+    must not smuggle a transfer, an f64 upcast, a logits-shaped output or an
+    undonated pool copy into the program.  `engine` injects a prebuilt
+    quantized engine (tpu_cost builds one for the at-rest account anyway)."""
+    import jax.numpy as jnp
+
+    qeng = engine
+    if qeng is None:
+        qeng, _ = _build_engine(mp, weight_dtype="int8", kv_dtype="int8")
+    B = qeng.cache.num_slots
+    P = qeng.cache.max_pages_per_slot
+    i32 = jnp.int32
+    tag = f"mp{mp}." if mp > 1 else ""
+    Tf = qeng._fused_T
+    return [
+        (f"serve.{tag}fused_step_int8", getattr(qeng._decode_fn, "_jit",
+                                                qeng._decode_fn),
+         (qeng.params, jnp.zeros((B, Tf), i32), qeng._pool,
+          jnp.zeros((B, P), i32), jnp.zeros((B,), i32),
+          jnp.ones((B,), i32), qeng._key, jnp.zeros((B,), bool)),
+         dict(donate_paths=("arg2",), keep_paths=("arg0",),
+              host_output_budget=B * (Tf + 2) + 2,
+              require_sharding_constraint=mp > 1)),
     ]
 
 
@@ -345,6 +377,6 @@ def run_jaxpr_checks(include_mp: bool = True,
     if include_mp and len(jax.devices()) >= mp:
         passes.append(mp)
     for m in passes:
-        for name, fn, args, kw in serving_targets(m):
+        for name, fn, args, kw in serving_targets(m) + quantized_targets(m):
             findings.extend(audit_jaxpr(name, fn, args, **kw))
     return findings

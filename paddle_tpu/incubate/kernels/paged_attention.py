@@ -77,6 +77,9 @@ from .flash_attention import NEG_INF, _on_tpu
 #   head layout and GSPMD partitions the gather+einsum (the gather indexes the
 #   pool's page axis, which is unsharded, so it stays collective-free).
 _POOL_SPEC = P(None, None, "mp", None)      # [num_pages, page, KVH, hd]
+# quantized-pool scale lanes [num_pages, page, KVH]: per-token-per-head f32
+# scales shard on the SAME KVH axis as the int8 pages they dequantize
+_SCALE_SPEC = P(None, None, "mp")
 
 
 def _mp_degree(mesh) -> int:
@@ -103,18 +106,33 @@ def _pin(mesh, x, spec):
 
 def paged_attention_decode_mp(q, k_pages, v_pages, page_table, lengths,
                               mesh, scale=None, use_pallas=None,
-                              interpret=False):
+                              interpret=False, kv_scales=None):
     """Head-sharded `paged_attention_decode` over the `mp` axis of `mesh`.
 
     use_pallas=None auto-selects (TPU + kernel-friendly layout); tests force
-    True with interpret=True to run the shard_mapped kernel on CPU."""
+    True with interpret=True to run the shard_mapped kernel on CPU.
+    kv_scales (int8 pool) shard on the same KVH axis as the pages — the
+    dequant is per-head-local, so the mp distribution is unchanged."""
     from ...parallel.ring_attention import shard_map_compat
 
     mp = _mp_degree(mesh)
     _check_mp_heads(q.shape[1], k_pages.shape[2], mp)
     if use_pallas is None:
-        use_pallas = _on_tpu() and _shapes_ok_for_pallas(q, k_pages)
+        use_pallas = _on_tpu() and _shapes_ok_for_pallas(
+            q, k_pages, quantized=kv_scales is not None)
     if use_pallas:
+        if kv_scales is not None:
+            def local_q(tbl, ln, q_l, k_l, v_l, ks_l, vs_l):
+                return paged_attention_pallas(q_l, k_l, v_l, tbl, ln,
+                                              scale=scale, interpret=interpret,
+                                              kv_scales=(ks_l, vs_l))
+            return shard_map_compat(
+                local_q, mesh=mesh, axis_names={"mp"},
+                in_specs=(P(None, None), P(None), _head_spec(3), _POOL_SPEC,
+                          _POOL_SPEC, _SCALE_SPEC, _SCALE_SPEC),
+                out_specs=_head_spec(3))(page_table, lengths, q, k_pages,
+                                         v_pages, *kv_scales)
+
         def local(tbl, ln, q_l, k_l, v_l):
             return paged_attention_pallas(q_l, k_l, v_l, tbl, ln, scale=scale,
                                           interpret=interpret)
@@ -126,14 +144,17 @@ def paged_attention_decode_mp(q, k_pages, v_pages, page_table, lengths,
     q = _pin(mesh, q, _head_spec(3))
     k_pages = _pin(mesh, k_pages, _POOL_SPEC)
     v_pages = _pin(mesh, v_pages, _POOL_SPEC)
+    if kv_scales is not None:
+        kv_scales = (_pin(mesh, kv_scales[0], _SCALE_SPEC),
+                     _pin(mesh, kv_scales[1], _SCALE_SPEC))
     out = paged_attention_xla(q, k_pages, v_pages, page_table, lengths,
-                              scale=scale)
+                              scale=scale, kv_scales=kv_scales)
     return _pin(mesh, out, _head_spec(3))
 
 
 def paged_prefill_attention_mp(q, k_pages, v_pages, page_table, q_offset,
                                valid, mesh, scale=None, use_pallas=None,
-                               interpret=False):
+                               interpret=False, kv_scales=None):
     """Head-sharded `paged_prefill_attention` (and, via
     `paged_verify_attention`, the spec-decode verify lane) over `mp`."""
     from ...parallel.ring_attention import shard_map_compat
@@ -141,8 +162,21 @@ def paged_prefill_attention_mp(q, k_pages, v_pages, page_table, q_offset,
     mp = _mp_degree(mesh)
     _check_mp_heads(q.shape[2], k_pages.shape[2], mp)
     if use_pallas is None:
-        use_pallas = _on_tpu() and _shapes_ok_for_pallas(q, k_pages)
+        use_pallas = _on_tpu() and _shapes_ok_for_pallas(
+            q, k_pages, quantized=kv_scales is not None)
     if use_pallas:
+        if kv_scales is not None:
+            def local_q(tbl, qo, vl, q_l, k_l, v_l, ks_l, vs_l):
+                return paged_prefill_attention_pallas(
+                    q_l, k_l, v_l, tbl, qo, vl, scale=scale,
+                    interpret=interpret, kv_scales=(ks_l, vs_l))
+            return shard_map_compat(
+                local_q, mesh=mesh, axis_names={"mp"},
+                in_specs=(P(None, None), P(None), P(None), _head_spec(4),
+                          _POOL_SPEC, _POOL_SPEC, _SCALE_SPEC, _SCALE_SPEC),
+                out_specs=_head_spec(4))(page_table, q_offset, valid, q,
+                                         k_pages, v_pages, *kv_scales)
+
         def local(tbl, qo, vl, q_l, k_l, v_l):
             return paged_prefill_attention_pallas(q_l, k_l, v_l, tbl, qo, vl,
                                                   scale=scale,
@@ -156,12 +190,25 @@ def paged_prefill_attention_mp(q, k_pages, v_pages, page_table, q_offset,
     q = _pin(mesh, q, _head_spec(4))
     k_pages = _pin(mesh, k_pages, _POOL_SPEC)
     v_pages = _pin(mesh, v_pages, _POOL_SPEC)
+    if kv_scales is not None:
+        kv_scales = (_pin(mesh, kv_scales[0], _SCALE_SPEC),
+                     _pin(mesh, kv_scales[1], _SCALE_SPEC))
     out = paged_prefill_attention_xla(q, k_pages, v_pages, page_table,
-                                      q_offset, valid, scale=scale)
+                                      q_offset, valid, scale=scale,
+                                      kv_scales=kv_scales)
     return _pin(mesh, out, _head_spec(4))
 
 
-def paged_attention_xla(q, k_pages, v_pages, page_table, lengths, scale=None):
+def _dequant_gathered(pages, scales, page_table, B, S, KVH, hd):
+    """Gather int8 pages through the table and dequantize by their per-token
+    scales (float32) — the oracle twin of the kernels' per-page dequant."""
+    x = pages[page_table].reshape(B, S, KVH, hd).astype(jnp.float32)
+    s = scales[page_table].reshape(B, S, KVH)
+    return x * s[..., None]
+
+
+def paged_attention_xla(q, k_pages, v_pages, page_table, lengths, scale=None,
+                        kv_scales=None):
     """Gather-based paged decode attention (fallback + oracle).
 
     q: [B, H, hd] — one query token per slot.
@@ -169,6 +216,9 @@ def paged_attention_xla(q, k_pages, v_pages, page_table, lengths, scale=None):
     page_table: [B, max_pages] int32 page ids (0 = reserved null page).
     lengths: [B] int32 — number of valid tokens per slot (including the token
         just written at position lengths-1).
+    kv_scales: (k_scale, v_scale) [P, page_size, KVH] float32 for an int8
+        pool — gathered pages dequantize to float32 before the score/PV
+        matmuls (same math as the Pallas kernels, so parity stays exact).
     Returns [B, H, hd].
     """
     B, H, hd = q.shape
@@ -177,8 +227,12 @@ def paged_attention_xla(q, k_pages, v_pages, page_table, lengths, scale=None):
     G = H // KVH
     S = page_table.shape[1] * page
     s = scale if scale is not None else 1.0 / math.sqrt(hd)
-    k = k_pages[page_table].reshape(B, S, KVH, hd)
-    v = v_pages[page_table].reshape(B, S, KVH, hd)
+    if kv_scales is not None:
+        k = _dequant_gathered(k_pages, kv_scales[0], page_table, B, S, KVH, hd)
+        v = _dequant_gathered(v_pages, kv_scales[1], page_table, B, S, KVH, hd)
+    else:
+        k = k_pages[page_table].reshape(B, S, KVH, hd)
+        v = v_pages[page_table].reshape(B, S, KVH, hd)
     qg = q.reshape(B, KVH, G, hd)
     logits = jnp.einsum("bkgd,bskd->bkgs", qg, k,
                         preferred_element_type=jnp.float32) * s
@@ -190,14 +244,21 @@ def paged_attention_xla(q, k_pages, v_pages, page_table, lengths, scale=None):
     return out.reshape(B, H, hd)
 
 
-def _paged_attn_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                       acc_ref, m_ref, l_ref, *, page: int, KVH: int, G: int,
-                       n_pages: int, scale: float):
+def _paged_attn_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, *refs,
+                       page: int, KVH: int, G: int,
+                       n_pages: int, scale: float, quantized: bool = False):
     """Grid (B, max_pages): slots parallel, pages innermost with online-softmax
     scratch carry (acc, m, l) — same discipline as the flash forward kernel,
-    but the k/v blocks arrive via the scalar-prefetched page table."""
+    but the k/v blocks arrive via the scalar-prefetched page table.  With
+    `quantized`, two extra scale refs ([1, page, KVH] float32) follow v_ref
+    and the int8 page block dequantizes to f32 right after its DMA — the
+    per-page dequant-on-read that keeps the fp pool out of HBM entirely."""
     from jax.experimental import pallas as pl
 
+    if quantized:
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        o_ref, acc_ref, m_ref, l_ref = refs
     b = pl.program_id(0)
     j = pl.program_id(1)
     H = KVH * G
@@ -217,6 +278,9 @@ def _paged_attn_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0]                                    # [H, hd]
         k = k_ref[0]                                    # [page, KVH, hd]
         v = v_ref[0]
+        if quantized:
+            k = k.astype(jnp.float32) * ks_ref[0][..., None]
+            v = v.astype(jnp.float32) * vs_ref[0][..., None]
         # GQA: per-kv-head score tiles stacked back to [H, page] rows
         rows = []
         for kh in range(KVH):
@@ -248,13 +312,15 @@ def _paged_attn_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def paged_attention_pallas(q, k_pages, v_pages, page_table, lengths,
-                           scale=None, interpret=False):
+                           scale=None, interpret=False, kv_scales=None):
     """Pallas paged decode attention — same contract as `paged_attention_xla`.
 
     The page table and lengths ride `PrefetchScalarGridSpec` so the k/v
     BlockSpec index_maps resolve `pool[table[b, j]]` at DMA time; the pool is
-    never gathered into a dense per-slot copy.  `interpret=True` runs the
-    kernel on CPU for numerics tests.
+    never gathered into a dense per-slot copy.  With `kv_scales` (int8 pool)
+    the per-page scale blocks ride the SAME table-indexed DMA and the page
+    dequantizes in VMEM on read.  `interpret=True` runs the kernel on CPU
+    for numerics tests.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -267,17 +333,24 @@ def paged_attention_pallas(q, k_pages, v_pages, page_table, lengths,
     s = scale if scale is not None else 1.0 / math.sqrt(hd)
 
     kernel = functools.partial(_paged_attn_kernel, page=page, KVH=KVH, G=G,
-                               n_pages=n_pages, scale=s)
+                               n_pages=n_pages, scale=s,
+                               quantized=kv_scales is not None)
+    pool_spec = pl.BlockSpec((1, page, KVH, hd),
+                             lambda b, j, tbl, ln: (tbl[b, j], 0, 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, H, hd), lambda b, j, tbl, ln: (b, 0, 0)),
+        pool_spec, pool_spec,
+    ]
+    args = [q, k_pages, v_pages]
+    if kv_scales is not None:
+        scale_spec = pl.BlockSpec((1, page, KVH),
+                                  lambda b, j, tbl, ln: (tbl[b, j], 0, 0))
+        in_specs += [scale_spec, scale_spec]
+        args += [kv_scales[0], kv_scales[1]]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,          # (page_table, lengths)
         grid=(B, n_pages),
-        in_specs=[
-            pl.BlockSpec((1, H, hd), lambda b, j, tbl, ln: (b, 0, 0)),
-            pl.BlockSpec((1, page, KVH, hd),
-                         lambda b, j, tbl, ln: (tbl[b, j], 0, 0, 0)),
-            pl.BlockSpec((1, page, KVH, hd),
-                         lambda b, j, tbl, ln: (tbl[b, j], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, H, hd), lambda b, j, tbl, ln: (b, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((H, hd), jnp.float32),
@@ -293,11 +366,11 @@ def paged_attention_pallas(q, k_pages, v_pages, page_table, lengths,
         compiler_params=cparams(dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(jnp.asarray(page_table, jnp.int32), jnp.asarray(lengths, jnp.int32),
-      q, k_pages, v_pages)
+      *args)
 
 
 def paged_prefill_attention_xla(q, k_pages, v_pages, page_table, q_offset,
-                                valid, scale=None):
+                                valid, scale=None, kv_scales=None):
     """Gather-based chunked-prefill paged attention (fallback + oracle).
 
     q: [B, T, H, hd] — a chunk of T query tokens per slot; query t sits at
@@ -308,6 +381,8 @@ def paged_prefill_attention_xla(q, k_pages, v_pages, page_table, q_offset,
         written below it: cached pages or earlier chunks).
     valid: [B] int32 — real tokens in the chunk; rows t >= valid[b] compute
         garbage the caller ignores (their KV was routed to the null page).
+    kv_scales: (k_scale, v_scale) [P, page_size, KVH] float32 for an int8
+        pool — per-token dequant on read, same math as the Pallas kernel.
     Returns [B, T, H, hd].
     """
     B, T, H, hd = q.shape
@@ -316,8 +391,12 @@ def paged_prefill_attention_xla(q, k_pages, v_pages, page_table, q_offset,
     G = H // KVH
     S = page_table.shape[1] * page
     s = scale if scale is not None else 1.0 / math.sqrt(hd)
-    k = k_pages[page_table].reshape(B, S, KVH, hd)
-    v = v_pages[page_table].reshape(B, S, KVH, hd)
+    if kv_scales is not None:
+        k = _dequant_gathered(k_pages, kv_scales[0], page_table, B, S, KVH, hd)
+        v = _dequant_gathered(v_pages, kv_scales[1], page_table, B, S, KVH, hd)
+    else:
+        k = k_pages[page_table].reshape(B, S, KVH, hd)
+        v = v_pages[page_table].reshape(B, S, KVH, hd)
     qg = q.reshape(B, T, KVH, G, hd)
     logits = jnp.einsum("btkgd,bskd->bkgts", qg, k,
                         preferred_element_type=jnp.float32) * s
@@ -330,17 +409,23 @@ def paged_prefill_attention_xla(q, k_pages, v_pages, page_table, q_offset,
 
 
 def _paged_prefill_kernel(tbl_ref, qoff_ref, val_ref, q_ref, k_ref, v_ref,
-                          o_ref, acc_ref, m_ref, l_ref, *, page: int,
+                          *refs, page: int,
                           KVH: int, G: int, T: int, n_pages: int,
-                          scale: float):
+                          scale: float, quantized: bool = False):
     """Grid (B, max_pages): slots parallel, pages innermost with
     online-softmax scratch carry over T*H query rows (kh-major stacking, same
     discipline as the decode kernel).  The causal-at-offset mask
     `kv_pos <= q_offset + t` replaces the decode kernel's length mask; page 0
     always computes (every query row attends at least to kv position 0), so
-    the running max is finite before any fully-masked row/page combination."""
+    the running max is finite before any fully-masked row/page combination.
+    `quantized` adds two per-page scale refs after v_ref: the int8 page
+    block dequantizes to f32 on read, same math as the decode kernel."""
     from jax.experimental import pallas as pl
 
+    if quantized:
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        o_ref, acc_ref, m_ref, l_ref = refs
     b = pl.program_id(0)
     j = pl.program_id(1)
     H = KVH * G
@@ -361,6 +446,9 @@ def _paged_prefill_kernel(tbl_ref, qoff_ref, val_ref, q_ref, k_ref, v_ref,
         q = q_ref[0]                                    # [T, H, hd]
         k = k_ref[0]                                    # [page, KVH, hd]
         v = v_ref[0]
+        if quantized:
+            k = k.astype(jnp.float32) * ks_ref[0][..., None]
+            v = v.astype(jnp.float32) * vs_ref[0][..., None]
         rows = []
         for kh in range(KVH):
             qh = q[:, kh * G:(kh + 1) * G, :].reshape(T * G, -1)
@@ -397,11 +485,13 @@ def _paged_prefill_kernel(tbl_ref, qoff_ref, val_ref, q_ref, k_ref, v_ref,
 
 
 def paged_prefill_attention_pallas(q, k_pages, v_pages, page_table, q_offset,
-                                   valid, scale=None, interpret=False):
+                                   valid, scale=None, interpret=False,
+                                   kv_scales=None):
     """Pallas chunked-prefill paged attention — same contract as
     `paged_prefill_attention_xla`.  page_table / q_offset / valid ride
-    `PrefetchScalarGridSpec`; `interpret=True` runs on CPU for numerics
-    tests."""
+    `PrefetchScalarGridSpec`; `kv_scales` (int8 pool) adds table-indexed
+    per-page scale blocks dequantized on read; `interpret=True` runs on CPU
+    for numerics tests."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -413,17 +503,24 @@ def paged_prefill_attention_pallas(q, k_pages, v_pages, page_table, q_offset,
     s = scale if scale is not None else 1.0 / math.sqrt(hd)
 
     kernel = functools.partial(_paged_prefill_kernel, page=page, KVH=KVH,
-                               G=G, T=T, n_pages=n_pages, scale=s)
+                               G=G, T=T, n_pages=n_pages, scale=s,
+                               quantized=kv_scales is not None)
+    pool_spec = pl.BlockSpec((1, page, KVH, hd),
+                             lambda b, j, tbl, qo, vl: (tbl[b, j], 0, 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, T, H, hd), lambda b, j, tbl, qo, vl: (b, 0, 0, 0)),
+        pool_spec, pool_spec,
+    ]
+    args = [q, k_pages, v_pages]
+    if kv_scales is not None:
+        scale_spec = pl.BlockSpec((1, page, KVH),
+                                  lambda b, j, tbl, qo, vl: (tbl[b, j], 0, 0))
+        in_specs += [scale_spec, scale_spec]
+        args += [kv_scales[0], kv_scales[1]]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,          # (page_table, q_offset, valid)
         grid=(B, n_pages),
-        in_specs=[
-            pl.BlockSpec((1, T, H, hd), lambda b, j, tbl, qo, vl: (b, 0, 0, 0)),
-            pl.BlockSpec((1, page, KVH, hd),
-                         lambda b, j, tbl, qo, vl: (tbl[b, j], 0, 0, 0)),
-            pl.BlockSpec((1, page, KVH, hd),
-                         lambda b, j, tbl, qo, vl: (tbl[b, j], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, T, H, hd),
                                lambda b, j, tbl, qo, vl: (b, 0, 0, 0)),
         scratch_shapes=[
@@ -440,32 +537,44 @@ def paged_prefill_attention_pallas(q, k_pages, v_pages, page_table, q_offset,
         compiler_params=cparams(dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(jnp.asarray(page_table, jnp.int32), jnp.asarray(q_offset, jnp.int32),
-      jnp.asarray(valid, jnp.int32), q, k_pages, v_pages)
+      jnp.asarray(valid, jnp.int32), *args)
 
 
 def paged_prefill_attention(q, k_pages, v_pages, page_table, q_offset, valid,
-                            scale=None, mesh=None):
+                            scale=None, mesh=None, kv_scales=None):
     """Entry used by `models.gpt.prefill_chunk_paged`: Pallas on TPU when the
     layout is kernel-friendly, gather fallback otherwise.  mesh (with an 'mp'
-    axis > 1) runs head-sharded tensor-parallel."""
+    axis > 1) runs head-sharded tensor-parallel.  kv_scales (int8 pool)
+    selects the per-page dequant-on-read lane in every route."""
     if _mp_degree(mesh) > 1:
         return paged_prefill_attention_mp(q, k_pages, v_pages, page_table,
-                                          q_offset, valid, mesh, scale=scale)
-    if _on_tpu() and _shapes_ok_for_pallas(q, k_pages):
+                                          q_offset, valid, mesh, scale=scale,
+                                          kv_scales=kv_scales)
+    if _on_tpu() and _shapes_ok_for_pallas(q, k_pages,
+                                           quantized=kv_scales is not None):
         return paged_prefill_attention_pallas(q, k_pages, v_pages, page_table,
-                                              q_offset, valid, scale=scale)
+                                              q_offset, valid, scale=scale,
+                                              kv_scales=kv_scales)
     return paged_prefill_attention_xla(q, k_pages, v_pages, page_table,
-                                       q_offset, valid, scale=scale)
+                                       q_offset, valid, scale=scale,
+                                       kv_scales=kv_scales)
 
 
-def _shapes_ok_for_pallas(q, k_pages):
+def _shapes_ok_for_pallas(q, k_pages, quantized=False):
     hd = q.shape[-1]
     page = k_pages.shape[1]
-    return hd in (64, 128, 256) and page % 8 == 0
+    ok = hd in (64, 128, 256) and page % 8 == 0
+    if quantized:
+        # int8 VMEM tiles are (32, 128) (pallas guide): keep the auto-route
+        # to the kernel conservative on quantized pools — hd a full lane
+        # width and whole-sublane pages — until the int8 layout is validated
+        # on real hardware; anything else takes the XLA dequant-gather path
+        ok = ok and hd in (128, 256) and page % 32 == 0
+    return ok
 
 
 def paged_verify_attention(q, k_pages, v_pages, page_table, lengths, valid,
-                           scale=None, mesh=None):
+                           scale=None, mesh=None, kv_scales=None):
     """Entry used by `models.gpt.verify_step_paged`: multi-token (q_len > 1)
     decode over the paged pool.  q [B, T, H, hd] holds the last emitted token
     plus up to T-1 drafted tokens per slot; query t sits at absolute position
@@ -474,11 +583,12 @@ def paged_verify_attention(q, k_pages, v_pages, page_table, lengths, valid,
     the chunked-prefill pair with `q_offset = lengths` — one kernel serves
     both lanes, keeping the decode-side compiled-program count at two."""
     return paged_prefill_attention(q, k_pages, v_pages, page_table, lengths,
-                                   valid, scale=scale, mesh=mesh)
+                                   valid, scale=scale, mesh=mesh,
+                                   kv_scales=kv_scales)
 
 
 def paged_serve_attention(q, k_pages, v_pages, page_table, q_offset, valid,
-                          scale=None, mesh=None):
+                          scale=None, mesh=None, kv_scales=None):
     """Entry used by `models.gpt.serve_step_paged` — the fused one-dispatch
     engine step.  Identical math to the prefill/verify pair (causal-at-offset
     through the page table), but the batch is heterogeneous: each slot's
@@ -490,19 +600,22 @@ def paged_serve_attention(q, k_pages, v_pages, page_table, q_offset, valid,
     which is what lets the engine dispatch exactly one program per
     iteration."""
     return paged_prefill_attention(q, k_pages, v_pages, page_table, q_offset,
-                                   valid, scale=scale, mesh=mesh)
+                                   valid, scale=scale, mesh=mesh,
+                                   kv_scales=kv_scales)
 
 
 def paged_attention_decode(q, k_pages, v_pages, page_table, lengths,
-                           scale=None, mesh=None):
+                           scale=None, mesh=None, kv_scales=None):
     """Entry used by `models.gpt.decode_step_paged`: Pallas on TPU when the
     layout is kernel-friendly, gather fallback otherwise.  mesh (with an 'mp'
     axis > 1) runs head-sharded tensor-parallel."""
     if _mp_degree(mesh) > 1:
         return paged_attention_decode_mp(q, k_pages, v_pages, page_table,
-                                         lengths, mesh, scale=scale)
-    if _on_tpu() and _shapes_ok_for_pallas(q, k_pages):
+                                         lengths, mesh, scale=scale,
+                                         kv_scales=kv_scales)
+    if _on_tpu() and _shapes_ok_for_pallas(q, k_pages,
+                                           quantized=kv_scales is not None):
         return paged_attention_pallas(q, k_pages, v_pages, page_table, lengths,
-                                      scale=scale)
+                                      scale=scale, kv_scales=kv_scales)
     return paged_attention_xla(q, k_pages, v_pages, page_table, lengths,
-                               scale=scale)
+                               scale=scale, kv_scales=kv_scales)

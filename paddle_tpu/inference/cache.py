@@ -155,6 +155,20 @@ class PagedKVCache:
         """Requests currently parked in the host swap pool."""
         return len(self._swapped)
 
+    def host_pool_room(self, budget_pages: int) -> int:
+        """Pages of host swap-pool room left under `budget_pages`: the
+        budget minus the parked KV already counted against it.  The
+        PREEMPTION decision reads this number (can the victim park *now*,
+        given what is already parked) so the parked-KV account cannot be
+        double-spent.  Intake admission deliberately does NOT — it compares
+        the request's worst case against the raw budget (could it EVER
+        park, even in an empty pool), because a transiently full pool must
+        queue-and-drain, not reject (see `LLMEngine.add_request`).  Page
+        counts are
+        dtype-oblivious: an int8 pool parks the same page count in ~2-4x
+        fewer host bytes (`LLMEngine.swap_pool_bytes`)."""
+        return budget_pages - self.swapped_page_count
+
     def pool_pressure(self) -> float:
         """Fraction of the real pool in live use (0.0 idle .. 1.0 full) —
         the overload gauge victim selection and dashboards key on."""

@@ -421,6 +421,19 @@ class LLMEngine:
     pressure / swap-copy failures / clock skew (tests only; see
     `inference.faults.FaultPlan`).
 
+    Quantized serving: `weight_dtype="int8"` PTQ-quantizes the serving
+    matmul weights once at init (symmetric per-channel,
+    `quantization.serving.quantize_serving_params`; dequant rides per block
+    inside the existing executables — zero program-count change) and
+    `kv_dtype="int8"` stores the KV page pool as int8 pages + per-token
+    scale lanes, quantized at every in-program write and dequantized per
+    page on read inside the paged-attention kernels.  Both default off and
+    the fp engine is byte-identical to a quantization-free build; the
+    quantized engine keeps every internal parity bar (fused/mp/preempt)
+    against itself, while outputs vs the fp engine are a top-1 agreement
+    RATE (quantization is lossy) reported by `bench_serve.py
+    --weight-dtype/--kv-dtype int8`.
+
     `mp=N` (or an explicit `mesh` with an 'mp' axis) serves tensor-parallel
     over N chips: params are placed ONCE at init in the Megatron serving
     layout (`parallel.hybrid.serving_param_specs` — qkv/fc1 column-, proj/fc2
@@ -452,11 +465,34 @@ class LLMEngine:
                  preempt: str = "recompute",
                  swap_pool_pages: Optional[int] = None,
                  fault_plan: Optional[FaultPlan] = None,
+                 weight_dtype: Optional[str] = None,
+                 kv_dtype: Optional[str] = None,
                  mesh=None, mp: Optional[int] = None,
                  seed: int = 0,
                  clock: Optional[Callable[[], float]] = None,
                  trace_ring: int = 512):
         import jax.sharding as jsh
+
+        from ..quantization.serving import (kv_page_bytes,
+                                            normalize_quant_dtype,
+                                            quantize_serving_params)
+
+        # quantized serving (ref QAT/PTQ deployment form + int8 predictor):
+        # weight_dtype="int8" PTQ-quantizes the serving matmul weights ONCE
+        # at init (symmetric per-channel; dequant rides inside the existing
+        # executables, so the program set is unchanged); kv_dtype="int8"
+        # stores the KV page pool as int8 + per-token scale lanes (the
+        # paged-attention kernels dequantize per page on read).  Both default
+        # OFF — the fp engine is byte-identical to a quantization-free build.
+        self.weight_dtype = normalize_quant_dtype(weight_dtype, "weight_dtype")
+        self.kv_dtype = normalize_quant_dtype(kv_dtype, "kv_dtype")
+        self._kv_page_bytes = kv_page_bytes(config, page_size, self.kv_dtype)
+        if self.weight_dtype == "int8":
+            # quantization is host numpy; re-place the tree ONCE here so no
+            # dispatch ever pays an implicit h2d for a param leaf (the
+            # steady-state loop runs under transfer_guard("disallow"))
+            params = jax.tree_util.tree_map(
+                jnp.asarray, quantize_serving_params(params, config))
 
         if mp is not None and mp > 1 and mesh is None:
             from ..parallel.hybrid import serving_mesh
@@ -581,7 +617,8 @@ class LLMEngine:
         # optimistic-admission watermark: global free-page headroom kept back
         # at admission (vLLM's watermark_blocks), ~1% of the pool
         self._watermark = max(1, (self.cache.num_pages - 1) // 100)
-        self._pool = gpt_mod.init_paged_cache(config, num_pages, page_size)
+        self._pool = gpt_mod.init_paged_cache(config, num_pages, page_size,
+                                              kv_dtype=self.kv_dtype)
         if self._pool_sharding is not None:
             self._pool = jax.device_put(
                 self._pool, {n: self._pool_sharding for n in self._pool})
@@ -657,6 +694,10 @@ class LLMEngine:
         self._rejected_requests = m.counter(
             "rejected_requests",
             "requests rejected at intake (footprint can never fit)")
+        self._intake_swap_rejects = m.counter(
+            "intake_swap_rejects",
+            "intake rejections because the worst-case footprint exceeds the "
+            "host swap pool (the request could never be parked)")
         self._h_queue = m.histogram("queue_time_seconds",
                                     help="enqueue -> admission into a slot")
         self._h_ttft = m.histogram("ttft_seconds",
@@ -671,6 +712,8 @@ class LLMEngine:
         m.gauge("prefilling", lambda: len(self._prefilling),
                 "slots mid-prefill")
         m.gauge("running", lambda: len(self._running), "slots decoding")
+        m.gauge("kv_pool_bytes", self.kv_pool_bytes,
+                "at-rest bytes of the device KV page pool (all lanes)")
         self.cache.attach_metrics(m)
         self._lifecycles: Dict[int, RequestMetrics] = {}
         self._step_idx = 0
@@ -772,13 +815,14 @@ class LLMEngine:
             # (the gather stays chip-local; the host fetch assembles).
             return pin_pool(gpt_mod.swap_out_pages(pool, ids))
 
-        def swap_in_impl(pool, ids, k, v):
+        def swap_in_impl(pool, ids, data):
             # preemption swap-in: scatter the parked KV back into freshly
-            # allocated pages, in place.  Only the pool is donated — the k/v
-            # staging uploads cannot alias the pool-shaped output, so
-            # donating them would just burn a "donation unusable" warning
-            # per swap-in
-            return pin_pool(gpt_mod.swap_in_pages(pool, ids, k, v))
+            # allocated pages, in place (`data` is the pool-keyed staging
+            # dict — int8 pools restore their scale lanes in the same
+            # dispatch).  Only the pool is donated — the staging uploads
+            # cannot alias the pool-shaped output, so donating them would
+            # just burn a "donation unusable" warning per swap-in
+            return pin_pool(gpt_mod.swap_in_pages(pool, ids, data))
 
         # pool donated: each step updates it in place instead of copying the
         # whole page pool every iteration.  The mp path AOT-compiles (see
@@ -896,10 +940,27 @@ class LLMEngine:
         req = Request(prompt, max_new_tokens, rid, t, temperature,
                       priority, deadline)
         self._lifecycles[rid] = RequestMetrics(t_enqueue=t)
-        if self.cache.pages_needed(total) > self.cache.num_pages - 1:
+        need = self.cache.pages_needed(total)
+        if need > self.cache.num_pages - 1:
             # fail fast: even alone on an empty pool this footprint cannot
             # fit — queueing it would wedge the queue head forever in
             # _admit's wait-for-pages path
+            self._rejected_requests.inc()
+            self._finish_output(req, [], "rejected", 0, None)
+            return rid
+        if self.optimistic and self.preempt == "swap" and \
+                self.swap_pool_pages > 0 and need > self.swap_pool_pages:
+            # swap-pool intake admission (PR-10 follow-on): under swap-mode
+            # oversubscription every admitted request is a preemption
+            # candidate, and its worst-case footprint counts against the
+            # HOST swap-pool budget at intake — a request that could never
+            # be parked even in an empty pool would degrade EVERY preemption
+            # of it to recompute (swap->recompute thrash), so it is rejected
+            # here.  A request that merely finds the pool transiently full
+            # queues as usual: parked victims re-queue at the head and drain
+            # the pool before fresh work reaches it.  swap_pool_pages=0
+            # declares parking disabled (pure recompute) — no gate.
+            self._intake_swap_rejects.inc()
             self._rejected_requests.inc()
             self._finish_output(req, [], "rejected", 0, None)
             return rid
@@ -1368,7 +1429,7 @@ class LLMEngine:
         L = int(mgr.lengths[slot])
         n = mgr.pages_needed(L)
         if self.preempt == "swap" and \
-                mgr.swapped_page_count + n <= self.swap_pool_pages:
+                n <= mgr.host_pool_room(self.swap_pool_pages):
             # gather the victim's pages into a standalone buffer NOW (the
             # pages are about to be handed to a new owner); the blocking
             # d2h fetch is deferred until after the next dispatch
@@ -1376,8 +1437,7 @@ class LLMEngine:
             ids[:n] = mgr.slot_pages(slot)[:n]
             data = self._swap_out_fn(self._pool, self._h2d(ids))
             self._swap_out_used = True
-            rec.update(kind="swap", L=L, n=n, k=data["k"], v=data["v"],
-                       fetched=False)
+            rec.update(kind="swap", L=L, n=n, data=data, fetched=False)
             mgr.note_swap_out(rid, n)
             self._pending_d2h.append(rec)
             # swapped_pages/preempt_swaps count at d2h SUCCESS (in
@@ -1403,8 +1463,8 @@ class LLMEngine:
         self._faults.d2h()
         t0 = self._now()
         with self._span("engine.swap.d2h"):
-            rec["k"] = np.asarray(rec["k"])[:, :rec["n"]]
-            rec["v"] = np.asarray(rec["v"])[:, :rec["n"]]
+            rec["data"] = {name: np.asarray(a)[:, :rec["n"]]
+                           for name, a in rec["data"].items()}
         self._swap_ms_c.inc((self._now() - t0) * 1e3)
         rec["fetched"] = True
         self._swapped_pages_c.inc(rec["n"])
@@ -1415,8 +1475,7 @@ class LLMEngine:
         the parked KV, clear the host-pool obligation, keep the banked
         generation — nothing leaks, the replay just costs prefill again."""
         rec["kind"] = "recompute"
-        rec.pop("k", None)
-        rec.pop("v", None)
+        rec.pop("data", None)
         self.cache.note_swap_in(rec["rid"])
         self._preempt_recomputes.inc()
 
@@ -1472,16 +1531,19 @@ class LLMEngine:
         n = rec["n"]
         ids = np.zeros((mgr.max_pages_per_slot,), np.int32)
         ids[:n] = mgr.slot_pages(slot)[:n]
-        k, v = rec["k"], rec["v"]
-        kd = np.zeros((k.shape[0], mgr.max_pages_per_slot) + k.shape[2:],
-                      k.dtype)
-        vd = np.zeros_like(kd)
-        kd[:, :n] = k
-        vd[:, :n] = v
+        data = {}
         t0 = self._now()
         with self._span("engine.swap.h2d"):
-            self._pool = self._swap_in_fn(self._pool, self._h2d(ids),
-                                          self._h2d(kd), self._h2d(vd))
+            # staging uploads count as h2d cost: swap_ms and the span cover
+            # the host->device copies AND the scatter dispatch, as in the
+            # single-lane (k, v) form this generalizes
+            for name, a in rec["data"].items():
+                pad = np.zeros(
+                    (a.shape[0], mgr.max_pages_per_slot) + a.shape[2:],
+                    a.dtype)
+                pad[:, :n] = a
+                data[name] = self._h2d(pad)
+            self._pool = self._swap_in_fn(self._pool, self._h2d(ids), data)
         self._swap_in_used = True
         self._swap_ms_c.inc((self._now() - t0) * 1e3)
         mgr.note_swap_in(rid)
@@ -1940,10 +2002,8 @@ class LLMEngine:
         self._swap_out_used = True
         # round-trip through host numpy so the swap-in signature matches the
         # real resume path (replicated staging uploads, not device outputs)
-        kd = np.asarray(data["k"])
-        vd = np.asarray(data["v"])
-        self._pool = self._swap_in_fn(self._pool, self._h2d(ids),
-                                      self._h2d(kd), self._h2d(vd))
+        staged = {n: self._h2d(np.asarray(a)) for n, a in data.items()}
+        self._pool = self._swap_in_fn(self._pool, self._h2d(ids), staged)
         self._swap_in_used = True
 
     def _maybe_finish(self, seq: _Running,
@@ -1965,14 +2025,21 @@ class LLMEngine:
 
     def swap_pool_bytes(self) -> int:
         """Worst-case HOST memory the swap pool may hold (the declared
-        bound `swap_pool_pages` times the k+v bytes of one page across all
-        layers) — the number `tools/tpu_cost.py` audits against
-        `SERVE_RESOURCE_BUDGET["swap_pool_bytes"]`.  Occupancy is the
+        bound `swap_pool_pages` times the bytes one page occupies across all
+        layers and pool lanes — k + v, plus the per-token scale lanes of an
+        int8 pool, `quantization.serving.kv_page_bytes`) — the number
+        `tools/tpu_cost.py` audits against
+        `SERVE_RESOURCE_BUDGET["swap_pool_bytes"]` (JXP009; int8 pools swap
+        int8 pages, so their bound shrinks with the pool).  Occupancy is the
         `kv_pages_swapped` gauge; this is the ceiling."""
-        k = self._pool["k"]         # [L, P, page, KVH, hd]
-        per_page = 2 * int(np.prod([k.shape[0], *k.shape[2:]])) * \
-            np.dtype(k.dtype).itemsize
-        return self.swap_pool_pages * per_page
+        return self.swap_pool_pages * self._kv_page_bytes
+
+    def kv_pool_bytes(self) -> int:
+        """At-rest bytes of the device KV page pool (all lanes — the number
+        the quantized-serving capacity math is about: int8 pools hold the
+        same token geometry in ~2-4x fewer bytes)."""
+        return int(sum(int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
+                       for a in self._pool.values()))
 
     def run(self) -> Dict[int, RequestOutput]:
         """Drain the queue: step until every request completes.  Returns
@@ -2113,9 +2180,15 @@ class LLMEngine:
             "recomputed_tokens": self._recomputed_tokens.value,
             "timeouts": self._timeouts.value,
             "rejected_requests": self._rejected_requests.value,
+            "intake_swap_rejects": self._intake_swap_rejects.value,
             "swapped": self.cache.swapped_requests,
             "kv_pages_swapped": self.cache.swapped_page_count,
             "kv_pool_pressure": round(self.cache.pool_pressure(), 4),
+            # quantized serving surface: the knobs and the at-rest pool bytes
+            # the capacity math is about (None = full-precision default)
+            "weight_dtype": self.weight_dtype,
+            "kv_dtype": self.kv_dtype,
+            "kv_pool_bytes": self.kv_pool_bytes(),
             # latency distributions (engine-side histograms; seconds) — the
             # serving SLO surface: benches report p50/p99 straight from here
             "latency": {

@@ -352,8 +352,70 @@ def epilogue(params, h, config: GPTConfig):
     return h
 
 
+def _deq(q, scale, dtype):
+    """Traced twin of `quantization.serving.dequantize_weight`: int8 values
+    times float32 per-channel scale, cast into the compute dtype.  EVERY
+    in-program weight dequant (blocks, embedding rows, head) goes through
+    this one expression so the scheme cannot desynchronize between sites."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def _w(bp, name, dtype):
+    """Weight `name` from a (possibly weight-quantized) param subtree.
+
+    `quantization.serving.quantize_serving_params` replaces a serving matmul
+    weight with the pair `name_q` (int8) + `name_scale` (float32, per output
+    channel); this helper dequantizes it on the fly into the compute dtype.
+    Called inside the layer scan, so the fp copy of a quantized weight only
+    ever exists one block at a time — at-rest HBM stays int8."""
+    q = bp.get(name + "_q")
+    if q is None:
+        return bp[name]
+    return _deq(q, bp[name + "_scale"], dtype)
+
+
+def _embed(params, tokens, config: GPTConfig):
+    """Token-table lookup, weight-quantization aware: int8 `wte_q` rows are
+    gathered first and dequantized by their per-row scale — the fp table is
+    never materialized."""
+    if "wte_q" in params:
+        rows = jnp.take(params["wte_q"], tokens, axis=0)
+        scale = jnp.take(params["wte_scale"], tokens, axis=0)
+        return _deq(rows, scale, config.dtype)
+    return jnp.take(params["wte"], tokens, axis=0)
+
+
 def head_matrix(params, config: GPTConfig):
-    return params["wte"].T if config.tie_word_embeddings else params["lm_head"]
+    if config.tie_word_embeddings:
+        if "wte_q" in params:
+            return _deq(params["wte_q"], params["wte_scale"],
+                        config.dtype).T
+        return params["wte"].T
+    if "lm_head_q" in params:
+        return _deq(params["lm_head_q"], params["lm_head_scale"],
+                    config.dtype)
+    return params["lm_head"]
+
+
+def head_logits(x, params, config: GPTConfig):
+    """Vocab projection `x @ head` for the serving executables.
+
+    Quantization-aware WITHOUT materializing the fp [V, D] table inside the
+    step (at real vocab sizes that transient alone would blow the declared
+    peak-HBM budgets): the matmul runs against the int8 table upcast to the
+    compute dtype — int8 values are exact in bf16/f32 — and the per-vocab
+    scales multiply the LOGITS columns afterward, which is the same math
+    because the scale is constant along the contraction dim.  The transient
+    is logits-shaped, not weight-shaped."""
+    if config.tie_word_embeddings and "wte_q" in params:
+        scale = params["wte_scale"].T                       # [V, 1] -> [1, V]
+        return (jnp.matmul(x, params["wte_q"].T.astype(config.dtype))
+                * scale).astype(config.dtype)
+    if not config.tie_word_embeddings and "lm_head_q" in params:
+        scale = params["lm_head_scale"]                     # already [1, V]
+        return (jnp.matmul(x, params["lm_head_q"].astype(config.dtype))
+                * scale).astype(config.dtype)
+    return jnp.matmul(x, head_matrix(params, config))
 
 
 def backbone(params, tokens, config: GPTConfig, mp_constraint=None, remat=False,
@@ -544,14 +606,15 @@ def init_cache(config: GPTConfig, batch: int, max_len: int):
 
 
 def _ffn_dense(bp, h, c: GPTConfig, mp_constraint=None):
-    """Dense-FFN body shared by the decode/prefill paths (gated + bias aware).
-    mp_constraint (serving tensor parallel) pins the column-sharded hidden."""
-    up = jnp.matmul(h, bp["fc1_w"])
+    """Dense-FFN body shared by the decode/prefill paths (gated + bias aware,
+    int8-weight aware via `_w`).  mp_constraint (serving tensor parallel)
+    pins the column-sharded hidden."""
+    up = jnp.matmul(h, _w(bp, "fc1_w", c.dtype))
     if "fc1_b" in bp:
         up = up + bp["fc1_b"]
     act = jax.nn.gelu if c.activation == "gelu" else jax.nn.silu
     if c.gated_ffn:
-        gate = jnp.matmul(h, bp["fcg_w"])
+        gate = jnp.matmul(h, _w(bp, "fcg_w", c.dtype))
         if "fcg_b" in bp:
             gate = gate + bp["fcg_b"]
         if mp_constraint:
@@ -562,7 +625,7 @@ def _ffn_dense(bp, h, c: GPTConfig, mp_constraint=None):
         if mp_constraint:
             up = mp_constraint(up, "ffn_mp")
         h = act(up)
-    out = jnp.matmul(h, bp["fc2_w"])
+    out = jnp.matmul(h, _w(bp, "fc2_w", c.dtype))
     if "fc2_b" in bp:
         out = out + bp["fc2_b"]
     return out
@@ -578,7 +641,7 @@ def _decode_qkv(bp, x, c: GPTConfig, pos):
     H, KVH, hd = c.num_heads, c.kv_heads, c.head_dim
     h = _norm(x, bp["ln1_w"], bp["ln1_b"], c) if c.norm_position == "pre" \
         else x
-    qkv = jnp.matmul(h, bp["qkv_w"])
+    qkv = jnp.matmul(h, _w(bp, "qkv_w", c.dtype))
     if "qkv_b" in bp:
         qkv = qkv + bp["qkv_b"]
     q, k, v = jnp.split(qkv, [H * hd, (H + KVH) * hd], axis=-1)
@@ -614,7 +677,7 @@ def _prefill_qkv(bp, x, c: GPTConfig, pos=None):
     H, KVH, hd = c.num_heads, c.kv_heads, c.head_dim
     h = _norm(x, bp["ln1_w"], bp["ln1_b"], c) if c.norm_position == "pre" \
         else x
-    qkv = jnp.matmul(h, bp["qkv_w"])
+    qkv = jnp.matmul(h, _w(bp, "qkv_w", c.dtype))
     if "qkv_b" in bp:
         qkv = qkv + bp["qkv_b"]
     q, k, v = jnp.split(qkv, [H * hd, (H + KVH) * hd], axis=-1)
@@ -636,7 +699,7 @@ def _layer_tail(bp, x, attn, c: GPTConfig, mp_constraint=None):
         # head-sharded attention flattens to a column-sharded hidden; pinning
         # it keeps the row-parallel proj matmul a local-contraction + psum
         attn = mp_constraint(attn, "hidden_mp")
-    attn = jnp.matmul(attn, bp["proj_w"])
+    attn = jnp.matmul(attn, _w(bp, "proj_w", c.dtype))
     if "proj_b" in bp:
         attn = attn + bp["proj_b"]
     x = x + attn
@@ -698,7 +761,7 @@ def decode_step(params, token, cache, pos, config: GPTConfig):
     x, (new_k, new_v) = jax.lax.scan(
         scan_body, x, (params["blocks"], cache["k"], cache["v"]))
     x = epilogue(params, x, c)
-    return jnp.matmul(x, head_matrix(params, c)), {"k": new_k, "v": new_v}
+    return head_logits(x, params, c), {"k": new_k, "v": new_v}
 
 
 def prefill(params, input_ids, config: GPTConfig, cache):
@@ -732,7 +795,7 @@ def prefill(params, input_ids, config: GPTConfig, cache):
         lambda carry, inp: layer(carry, inp),
         x, (params["blocks"], cache["k"], cache["v"]))
     x = epilogue(params, x[:, -1], c)
-    return jnp.matmul(x, head_matrix(params, c)), {"k": new_k, "v": new_v}
+    return head_logits(x, params, c), {"k": new_k, "v": new_v}
 
 
 # ---------------------------------------------------------------------------
@@ -742,13 +805,51 @@ def prefill(params, input_ids, config: GPTConfig, cache):
 # owns the page accounting; these are the compiled model-side steps.
 # ---------------------------------------------------------------------------
 
-def init_paged_cache(config: GPTConfig, num_pages: int, page_size: int):
+def init_paged_cache(config: GPTConfig, num_pages: int, page_size: int,
+                     kv_dtype=None):
     """Per-layer paged KV pool [L, num_pages, page_size, KVH, hd].
     Page 0 is reserved as the null page: inactive slots and padded bucket
-    tails write there, and it is never read (masked by per-slot length)."""
+    tails write there, and it is never read (masked by per-slot length).
+
+    kv_dtype="int8" stores int8 k/v plus per-token-per-head float32 scale
+    lanes `k_scale`/`v_scale` [L, num_pages, page_size, KVH]: every KV write
+    quantizes in-program (`_quantize_kv`) and the paged-attention kernels
+    dequantize per page on read.  Per-token scales keep the token-granular
+    write paths (decode append, chunked prefill, verify rollback, COW, swap)
+    exact and write-order independent — a coarser per-page scale would need
+    a lossy rescale of already-written tokens.  The default (None) is the
+    byte-identical fp pool."""
+    from ..quantization.serving import KV_SCALE_DTYPE, normalize_quant_dtype
     c = config
     shape = (c.num_layers, num_pages, page_size, c.kv_heads, c.head_dim)
+    if normalize_quant_dtype(kv_dtype, "kv_dtype") == "int8":
+        sshape = shape[:-1]
+        return {"k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.zeros(sshape, KV_SCALE_DTYPE),
+                "v_scale": jnp.zeros(sshape, KV_SCALE_DTYPE)}
     return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype)}
+
+
+def _quantize_kv(x):
+    """Symmetric per-token-per-head int8 quantization of a KV write
+    `[..., hd]` -> (int8 values [..., hd], float32 scale [...]).  Runs
+    INSIDE the serving executables at every KV write; the matching dequant
+    is `value * scale` in the paged-attention kernels/oracles."""
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(absmax, 1e-30) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127.0, 127.0) \
+        .astype(jnp.int8)
+    return q, scale
+
+
+def _kv_scales(kv):
+    """The attention entries' kv_scales lane: (k_scale, v_scale) for a
+    quantized per-layer pool slice, None for the fp pool."""
+    if "k_scale" in kv:
+        return kv["k_scale"], kv["v_scale"]
+    return None
 
 
 def serving_mp_constraint(mesh):
@@ -798,9 +899,10 @@ def decode_step_paged(params, tokens, cache, page_table, lengths,
     assert c.causal, "KV-cache decoding requires a causal model"
     B = tokens.shape[0]
     page = cache["k"].shape[2]
+    quant = "k_scale" in cache          # int8 pool: quantize writes in-program
     pos = lengths
     pin = serving_mp_constraint(mesh)
-    x = jnp.take(params["wte"], tokens, axis=0)              # [B, D]
+    x = _embed(params, tokens, c)                            # [B, D]
     if not c.use_rope:
         x = x + jnp.take(params["wpe"], pos, axis=0)
     page_idx = jnp.take_along_axis(page_table, (pos // page)[:, None],
@@ -808,22 +910,27 @@ def decode_step_paged(params, tokens, cache, page_table, lengths,
     offset = pos % page
 
     def layer(x, layer_in):
-        bp, kc, vc = layer_in                        # pool [P, page, KVH, hd]
+        bp, kv = layer_in                   # kv pool slices [P, page, KVH, hd]
         q, k, v = _decode_qkv(bp, x, c, pos)
         if pin:
             q, k, v = pin(q, "heads"), pin(k, "heads"), pin(v, "heads")
-        kc = kc.at[page_idx, offset].set(k)          # batched page scatter
-        vc = vc.at[page_idx, offset].set(v)
-        attn = paged_attention_decode(q, kc, vc, page_table, pos + 1,
-                                      mesh=mesh)
+        if quant:
+            k, ks = _quantize_kv(k)
+            v, vs = _quantize_kv(v)
+            kv = dict(kv, k_scale=kv["k_scale"].at[page_idx, offset].set(ks),
+                      v_scale=kv["v_scale"].at[page_idx, offset].set(vs))
+        kv = dict(kv, k=kv["k"].at[page_idx, offset].set(k),   # page scatter
+                  v=kv["v"].at[page_idx, offset].set(v))
+        attn = paged_attention_decode(q, kv["k"], kv["v"], page_table,
+                                      pos + 1, mesh=mesh,
+                                      kv_scales=_kv_scales(kv))
         x = _layer_tail(bp, x, attn.reshape(B, c.hidden_size), c, pin)
-        return x, (kc, vc)
+        return x, kv
 
-    x, (new_k, new_v) = jax.lax.scan(
-        lambda carry, inp: layer(carry, inp),
-        x, (params["blocks"], cache["k"], cache["v"]))
+    x, new_cache = jax.lax.scan(
+        lambda carry, inp: layer(carry, inp), x, (params["blocks"], cache))
     x = epilogue(params, x, c)
-    return jnp.matmul(x, head_matrix(params, c)), {"k": new_k, "v": new_v}
+    return head_logits(x, params, c), new_cache
 
 
 def prefill_paged(params, input_ids, config: GPTConfig, cache, pages, length,
@@ -847,8 +954,9 @@ def prefill_paged(params, input_ids, config: GPTConfig, cache, pages, length,
     D, H, KVH, hd = c.hidden_size, c.num_heads, c.kv_heads, c.head_dim
     page = cache["k"].shape[2]
     n_chunks = Sb // page
+    quant = "k_scale" in cache
     pin = serving_mp_constraint(mesh)
-    x = jnp.take(params["wte"], input_ids, axis=0)
+    x = _embed(params, input_ids, c)
     if not c.use_rope:
         x = x + params["wpe"][:Sb]
 
@@ -866,25 +974,40 @@ def prefill_paged(params, input_ids, config: GPTConfig, cache, pages, length,
             out_specs=hs)(q, k, v)
 
     def layer(x, layer_in):
-        bp, kc, vc = layer_in
+        bp, kv = layer_in
         q, k, v = _prefill_qkv(bp, x, c)
         if pin:
             q, k, v = pin(q, "heads"), pin(k, "heads"), pin(v, "heads")
-        kc = kc.at[pages].set(k.reshape(B, n_chunks, page, KVH, hd))
-        vc = vc.at[pages].set(v.reshape(B, n_chunks, page, KVH, hd))
+        # the dense in-chunk attention below reads the FULL-precision k/v —
+        # only the pool write quantizes, so a one-shot prompt's own logits
+        # see zero KV quantization error (it lands on later readers)
+        wk, wv = k, v
+        if quant:
+            wk, ks = _quantize_kv(k)
+            wv, vs = _quantize_kv(v)
+            kv = dict(
+                kv,
+                k_scale=kv["k_scale"].at[pages].set(
+                    ks.reshape(B, n_chunks, page, KVH)),
+                v_scale=kv["v_scale"].at[pages].set(
+                    vs.reshape(B, n_chunks, page, KVH)))
+        kv = dict(kv,
+                  k=kv["k"].at[pages].set(wk.reshape(B, n_chunks, page, KVH,
+                                                     hd)),
+                  v=kv["v"].at[pages].set(wv.reshape(B, n_chunks, page, KVH,
+                                                     hd)))
         if KVH != H:
             k = jnp.repeat(k, H // KVH, axis=2)
             v = jnp.repeat(v, H // KVH, axis=2)
         attn = attn_call(q, k, v).reshape(B, Sb, D)
         x = _layer_tail(bp, x, attn, c, pin)
-        return x, (kc, vc)
+        return x, kv
 
-    x, (new_k, new_v) = jax.lax.scan(
-        lambda carry, inp: layer(carry, inp),
-        x, (params["blocks"], cache["k"], cache["v"]))
+    x, new_cache = jax.lax.scan(
+        lambda carry, inp: layer(carry, inp), x, (params["blocks"], cache))
     x = x[jnp.arange(B), length - 1]                 # last real position
     x = epilogue(params, x, c)
-    return jnp.matmul(x, head_matrix(params, c)), {"k": new_k, "v": new_v}
+    return head_logits(x, params, c), new_cache
 
 
 def _paged_chunk_hidden(params, input_ids, config: GPTConfig, cache,
@@ -907,10 +1030,11 @@ def _paged_chunk_hidden(params, input_ids, config: GPTConfig, cache,
     B, C = input_ids.shape
     D = c.hidden_size
     page = cache["k"].shape[2]
+    quant = "k_scale" in cache
     pin = serving_mp_constraint(mesh)
     pos = q_offset[:, None] + jnp.arange(C)                  # [B, C]
     real = jnp.arange(C)[None, :] < valid[:, None]           # [B, C]
-    x = jnp.take(params["wte"], input_ids, axis=0)
+    x = _embed(params, input_ids, c)
     if not c.use_rope:
         # jnp.take clips padded-tail positions past wpe; their rows are junk
         # the scheduler never reads (rows >= valid are never consumed)
@@ -920,20 +1044,25 @@ def _paged_chunk_hidden(params, input_ids, config: GPTConfig, cache,
     off = pos % page
 
     def layer(x, layer_in):
-        bp, kc, vc = layer_in
+        bp, kv = layer_in
         q, k, v = _prefill_qkv(bp, x, c, pos=pos)
         if pin:
             q, k, v = pin(q, "heads"), pin(k, "heads"), pin(v, "heads")
-        kc = kc.at[pidx, off].set(k)          # token-granular page scatter
-        vc = vc.at[pidx, off].set(v)
-        attn = attn_fn(q, kc, vc, page_table, q_offset, valid, mesh=mesh)
+        if quant:
+            k, ks = _quantize_kv(k)
+            v, vs = _quantize_kv(v)
+            kv = dict(kv, k_scale=kv["k_scale"].at[pidx, off].set(ks),
+                      v_scale=kv["v_scale"].at[pidx, off].set(vs))
+        kv = dict(kv, k=kv["k"].at[pidx, off].set(k),   # token-granular write
+                  v=kv["v"].at[pidx, off].set(v))
+        attn = attn_fn(q, kv["k"], kv["v"], page_table, q_offset, valid,
+                       mesh=mesh, kv_scales=_kv_scales(kv))
         x = _layer_tail(bp, x, attn.reshape(B, C, D), c, pin)
-        return x, (kc, vc)
+        return x, kv
 
-    x, (new_k, new_v) = jax.lax.scan(
-        lambda carry, inp: layer(carry, inp),
-        x, (params["blocks"], cache["k"], cache["v"]))
-    return x, {"k": new_k, "v": new_v}
+    x, new_cache = jax.lax.scan(
+        lambda carry, inp: layer(carry, inp), x, (params["blocks"], cache))
+    return x, new_cache
 
 
 def prefill_chunk_paged(params, input_ids, config: GPTConfig, cache,
@@ -960,7 +1089,7 @@ def prefill_chunk_paged(params, input_ids, config: GPTConfig, cache,
                                    page_table, q_offset, valid, mesh=mesh)
     x = x[jnp.arange(B), valid - 1]                  # last real chunk position
     x = epilogue(params, x, config)
-    return jnp.matmul(x, head_matrix(params, config)), cache
+    return head_logits(x, params, config), cache
 
 
 def verify_step_paged(params, tokens, cache, page_table, lengths, valid,
@@ -992,7 +1121,7 @@ def verify_step_paged(params, tokens, cache, page_table, lengths, valid,
                                    attn_entry=paged_verify_attention,
                                    mesh=mesh)
     x = epilogue(params, x, config)
-    return jnp.matmul(x, head_matrix(params, config)), cache
+    return head_logits(x, params, config), cache
 
 
 def serve_step_paged(params, tokens, cache, page_table, q_offset, valid,
@@ -1034,7 +1163,7 @@ def serve_step_paged(params, tokens, cache, page_table, q_offset, valid,
                                    attn_entry=paged_serve_attention,
                                    mesh=mesh)
     x = epilogue(params, x, config)
-    logits = jnp.matmul(x, head_matrix(params, config))       # [B, T, V]
+    logits = head_logits(x, params, config)                   # [B, T, V]
     out = jnp.argmax(logits, axis=-1).astype(jnp.int32)       # [B, T]
     B, T = tokens.shape
     rows = jnp.arange(B)
@@ -1074,14 +1203,16 @@ def swap_out_pages(cache, page_ids):
     return {n: a[:, page_ids] for n, a in cache.items()}
 
 
-def swap_in_pages(cache, page_ids, k, v):
+def swap_in_pages(cache, page_ids, data):
     """Preemption swap-in scatter: restore a previously swapped victim's KV
     into its freshly allocated pages.  page_ids is padded with the null page
     0 exactly like `swap_out_pages` — padding rows scatter zeros into page 0,
-    which is written by every inactive slot anyway and never read.  The pool
-    arrives donated (in-place restore); returns the updated cache."""
-    return {"k": cache["k"].at[:, page_ids].set(k),
-            "v": cache["v"].at[:, page_ids].set(v)}
+    which is written by every inactive slot anyway and never read.  `data`
+    is the pool-keyed staging dict (`{"k", "v"}`, plus the scale lanes on a
+    quantized pool — int8 pages swap as int8, which is what halves the
+    JXP009 host-pool pressure).  The pool arrives donated (in-place
+    restore); returns the updated cache."""
+    return {n: a.at[:, page_ids].set(data[n]) for n, a in cache.items()}
 
 
 # LRU-bounded executable cache for `generate` (unbounded it leaks one compiled

@@ -146,9 +146,28 @@ def serving_param_specs(model_config, params):
     full [B, V] logits on the host every step, and a vocab-sharded head would
     put an allgather (or a distributed argmax) on the latency-critical decode
     dispatch; the transformer blocks — the bulk of the params at depth — are
-    what mp-sharding is for (per-chip block memory drops by mp×)."""
+    what mp-sharding is for (per-chip block memory drops by mp×).
+
+    Weight-quantized params (`quantization.serving.quantize_serving_params`)
+    replace a block weight with the `name_q` (int8) + `name_scale` (f32,
+    [L, 1, out]) pair: the int8 leaf keeps the fp weight's spec, and the
+    scale shards with the weight's CHANNEL (last) dim — sharded for the
+    column-parallel qkv/fc1/fcg (their scales split with the output
+    columns), replicated for the row-parallel proj/fc2 (whose output dim is
+    unsharded).  The quantized embedding/head pairs stay replicated like
+    the fp `wte`/`lm_head` they replace."""
     base = gpt_param_specs(MeshConfig(mp=2), model_config)["blocks"]
-    blocks = {k: base.get(k, P()) for k in params["blocks"]}
+
+    def block_spec(k):
+        if k.endswith("_q"):
+            return base.get(k[:-2], P())
+        if k.endswith("_scale"):
+            wspec = base.get(k[:-len("_scale")], P())
+            last = wspec[2] if len(wspec) > 2 else None
+            return P(None, None, "mp") if last is not None else P()
+        return base.get(k, P())
+
+    blocks = {k: block_spec(k) for k in params["blocks"]}
     specs = {k: P() for k in params if k != "blocks"}
     specs["blocks"] = blocks
     return specs

@@ -231,6 +231,12 @@ class ImperativeQuantAware:
         save(converted, path, input_spec=input_spec)
 
 
+from . import serving  # noqa: E402  (int8 serving params + KV page pool)
+from .serving import (  # noqa: E402
+    dequantize_weight, kv_page_bytes, quantize_serving_params,
+    quantize_weight)
+
 __all__ = ["QuantConfig", "QAT", "PTQ", "ImperativeQuantAware", "fake_quant",
            "AbsmaxObserver", "FakeQuanterWithAbsMaxObserver", "QuantedLinear",
-           "Int8Linear"]
+           "Int8Linear", "serving", "quantize_serving_params",
+           "quantize_weight", "dequantize_weight", "kv_page_bytes"]

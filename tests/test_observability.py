@@ -412,6 +412,9 @@ NEW_STATS_KEYS = frozenset({
     "preempt_swaps", "preempt_recomputes", "swapped_pages", "swap_ms",
     "recomputed_tokens", "timeouts", "rejected_requests", "swapped",
     "kv_pages_swapped", "kv_pool_pressure",
+}) | frozenset({
+    # added by the quantized-serving PR (weight/kv int8 + intake admission)
+    "weight_dtype", "kv_dtype", "kv_pool_bytes", "intake_swap_rejects",
 })
 
 

@@ -52,6 +52,9 @@ REQUIRED_STATS_KEYS = frozenset({
     "preempt_recomputes", "swapped_pages", "swap_ms", "recomputed_tokens",
     "timeouts", "rejected_requests", "swapped", "kv_pages_swapped",
     "kv_pool_pressure",
+    # quantized serving (ISSUE 11): the quantization knobs, the at-rest pool
+    # bytes the capacity math keys on, and the swap-pool intake gate counter
+    "weight_dtype", "kv_dtype", "kv_pool_bytes", "intake_swap_rejects",
 })
 REQUIRED_LATENCY_KEYS = frozenset(
     {"queue_s", "ttft_s", "tpot_s", "e2e_s", "step_s"})
@@ -63,11 +66,12 @@ REQUIRED_COUNTERS = frozenset({
     "finished_requests", "aborted_requests", "prefix_evictions",
     "preemptions", "preempt_swaps", "preempt_recomputes", "swapped_pages",
     "swap_ms", "recomputed_tokens", "timeouts", "rejected_requests",
+    "intake_swap_rejects",
 })
 REQUIRED_GAUGES = frozenset({
     "queued", "prefilling", "running", "kv_pages_in_use", "kv_pages_free",
     "kv_pages_evictable", "prefix_cached_pages", "kv_pages_swapped",
-    "kv_pool_pressure",
+    "kv_pool_pressure", "kv_pool_bytes",
 })
 REQUIRED_HISTOGRAMS = frozenset({
     "queue_time_seconds", "ttft_seconds", "tpot_seconds",

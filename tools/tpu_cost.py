@@ -72,6 +72,14 @@ def _print_report(reports) -> None:
         print(f"   page pool        "
               f"{_fmt_bytes(ar['pool_bytes_per_device'])}"
               f"  (global {_fmt_bytes(ar['pool_bytes'])})")
+        qr = rep.get("at_rest_quantized")
+        if qr is not None:
+            print(f"   int8 engine      pool "
+                  f"{_fmt_bytes(qr['pool_bytes'])} "
+                  f"({rep['quantized_pool_ratio']}x smaller), replicated "
+                  f"params {_fmt_bytes(qr['param_bytes_replicated'])} (fp "
+                  f"{_fmt_bytes(ar['param_bytes_replicated'])}), swap bound "
+                  f"{_fmt_bytes(rep['swap_pool_bytes_int8'])}")
         print(f"   {'program':28s} {'flops':>10s} {'peak HBM':>10s} "
               f"{'xla temp':>10s} {'coll B/step':>11s} {'pred ms':>8s}")
         for p in rep["programs"]:
