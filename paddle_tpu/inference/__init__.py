@@ -195,7 +195,9 @@ _SERVING = {"LLMEngine": "engine", "Request": "engine",
             "DraftProposer": "spec", "NgramProposer": "spec",
             "MetricsRegistry": "metrics", "Counter": "metrics",
             "Gauge": "metrics", "Histogram": "metrics",
-            "log_buckets": "metrics"}
+            "log_buckets": "metrics", "FleetMetrics": "metrics",
+            "RequestTrace": "tracing",
+            "ObservabilityServer": "obs_server"}
 
 
 def __getattr__(name):
@@ -211,4 +213,5 @@ __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "LLMEngine", "Request", "RequestOutput", "RequestMetrics",
            "PagedKVCache", "DraftProposer", "NgramProposer",
            "MetricsRegistry", "Counter", "Gauge", "Histogram",
-           "log_buckets"]
+           "log_buckets", "FleetMetrics", "RequestTrace",
+           "ObservabilityServer"]

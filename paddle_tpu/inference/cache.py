@@ -193,8 +193,11 @@ class PagedKVCache:
                        "pages registered in the prefix index")
         registry.gauge("kv_pages_swapped", lambda: self.swapped_page_count,
                        "pages whose KV lives in the host swap pool")
+        # ratio gauge: a fleet merge folds it by MAX (a sum of per-replica
+        # fractions would read >100% on a healthy fleet; the router's signal
+        # is the worst member)
         registry.gauge("kv_pool_pressure", self.pool_pressure,
-                       "fraction of the page pool in live use")
+                       "fraction of the page pool in live use", agg="max")
 
     # ---- prefix index -----------------------------------------------------
     def _match(self, tokens: np.ndarray

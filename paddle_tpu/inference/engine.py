@@ -164,6 +164,7 @@ from .cache import PagedKVCache
 from .faults import FaultInjected, FaultPlan
 from .metrics import MetricsRegistry
 from .spec import DraftProposer, NgramProposer
+from .tracing import RequestTrace
 
 
 @dataclasses.dataclass(eq=False)
@@ -219,6 +220,8 @@ class RequestOutput:
     cached_tokens: int = 0          # prompt tokens served from the prefix cache
     ttft_s: Optional[float] = None  # enqueue -> first generated token
     metrics: Optional[RequestMetrics] = None    # full lifecycle record
+    trace: Optional[RequestTrace] = None        # structured event timeline
+                                                # (None with tracing off)
 
     @property
     def tokens(self) -> np.ndarray:
@@ -470,7 +473,9 @@ class LLMEngine:
                  mesh=None, mp: Optional[int] = None,
                  seed: int = 0,
                  clock: Optional[Callable[[], float]] = None,
-                 trace_ring: int = 512):
+                 trace_ring: int = 512,
+                 request_tracing: bool = True,
+                 trace_retention: Optional[int] = 4096):
         import jax.sharding as jsh
 
         from ..quantization.serving import (kv_page_bytes,
@@ -640,6 +645,9 @@ class LLMEngine:
         # this, so the compiled-program budget is untouched) ----------------
         if trace_ring < 1:
             raise ValueError(f"trace_ring must be >= 1, got {trace_ring}")
+        if trace_retention is not None and trace_retention < 0:
+            raise ValueError(f"trace_retention must be >= 0 or None "
+                             f"(unbounded), got {trace_retention}")
         m = MetricsRegistry(namespace="llm_engine",
                             clock=clock or time.perf_counter)
         self.metrics = m
@@ -698,6 +706,18 @@ class LLMEngine:
             "intake_swap_rejects",
             "intake rejections because the worst-case footprint exceeds the "
             "host swap pool (the request could never be parked)")
+        # SLO accounting (deadline attainment + per-priority-class goodput):
+        # attainment's denominator is EVERY retired deadline-bearing request
+        # (timeouts and aborts count as misses there), while the latency
+        # histograms keep excluding them — two different questions
+        self._deadline_requests = m.counter(
+            "deadline_requests",
+            "retired requests that carried a deadline (attainment "
+            "denominator — timeouts/aborts/rejects included)")
+        self._deadline_met = m.counter(
+            "deadline_met",
+            "deadline-bearing requests that finished (stop/length) on time")
+        self._goodput_prio: Dict[int, object] = {}
         self._h_queue = m.histogram("queue_time_seconds",
                                     help="enqueue -> admission into a slot")
         self._h_ttft = m.histogram("ttft_seconds",
@@ -716,6 +736,19 @@ class LLMEngine:
                 "at-rest bytes of the device KV page pool (all lanes)")
         self.cache.attach_metrics(m)
         self._lifecycles: Dict[int, RequestMetrics] = {}
+        # per-request tracing (always-on observability plane; request_tracing
+        # =False strips both the timelines and the exemplar attachment — the
+        # bench's overhead A/B axis).  Live traces move to RequestOutput
+        # .trace at retirement, so /requests/<rid> keeps resolving after —
+        # for the last `trace_retention` retired requests: a long-running
+        # server retires millions, and timelines held forever on the
+        # RequestOutput ledger would grow host memory without bound, so the
+        # oldest retired trace is dropped (its output keeps its tokens) once
+        # the cap is passed.  trace_retention=None retains every timeline.
+        self._req_tracing = bool(request_tracing)
+        self._traces: Dict[int, RequestTrace] = {}
+        self._trace_retention = trace_retention
+        self._retired_traced: deque = deque()
         self._step_idx = 0
         self._step_trace: deque = deque(maxlen=trace_ring)
         self._tracing = False
@@ -880,7 +913,24 @@ class LLMEngine:
         (stats(), not executables) — benches call this after warmup so
         compile-time traffic is excluded.  Also clears the step-trace ring and
         the proposer's drafting telemetry; the `prefix_evictions` int mirrors
-        its registry counter so both zero together."""
+        its registry counter so both zero together.
+
+        Contract with an OPEN capture/trace window (audited; see
+        tests/test_observability.py::test_reset_counters_mid_trace_window):
+
+        - the chrome-trace host spans live in the profiler's own event
+          buffer, which this method never touches — a reset inside an
+          `engine.trace(dir)` window does not corrupt ``host_trace.json``;
+        - the step-trace ring and `_step_idx` restart at zero, so the
+          window's ``step_timeline.json`` holds only post-reset records
+          (by design: the same warmup-exclusion semantics as the counters);
+        - histogram resets clear their EXEMPLARS with their bucket counts
+          (`Histogram.reset`) — the exposition can never carry a stale
+          request handle on a bucket whose count says nothing was observed;
+        - live per-request timelines (`RequestOutput.trace` /
+          ``/requests/<rid>``) are request state, not counters: in-flight
+          traces and already-retired outputs survive, so exemplar handles
+          attached AFTER the reset keep resolving."""
         self.metrics.reset()
         self.cache.prefix_evictions = 0
         getattr(self.proposer, "reset_stats", lambda: None)()
@@ -940,6 +990,13 @@ class LLMEngine:
         req = Request(prompt, max_new_tokens, rid, t, temperature,
                       priority, deadline)
         self._lifecycles[rid] = RequestMetrics(t_enqueue=t)
+        if self._req_tracing:
+            tr = RequestTrace(rid)
+            tr.event(t, "enqueue", prompt_len=int(prompt.size),
+                     max_new_tokens=int(max_new_tokens),
+                     priority=int(priority),
+                     deadline_s=deadline_s)
+            self._traces[rid] = tr
         need = self.cache.pages_needed(total)
         if need > self.cache.num_pages - 1:
             # fail fast: even alone on an empty pool this footprint cannot
@@ -1030,7 +1087,8 @@ class LLMEngine:
         but every retirement gets its full RequestMetrics record and its own
         counter.  (The "rejected" counter is incremented at intake, where
         the decision is made.)"""
-        lc = self._lifecycles.pop(req.request_id, None)
+        rid = req.request_id
+        lc = self._lifecycles.pop(rid, None)
         if lc is not None:
             lc.t_finish = self._now()
             lc.e2e_s = lc.t_finish - lc.t_enqueue
@@ -1047,12 +1105,41 @@ class LLMEngine:
                 pass                    # counted at the intake decision
             else:
                 self._finished_requests.inc()
-                self._h_e2e.observe(lc.e2e_s)
+                ex = self._exemplar(rid)
+                self._h_e2e.observe(lc.e2e_s, exemplar=ex)
                 if lc.tpot_s is not None:
-                    self._h_tpot.observe(lc.tpot_s)
+                    self._h_tpot.observe(lc.tpot_s, exemplar=ex)
+            # SLO accounting: every retired deadline-bearing request lands in
+            # the attainment denominator; only an on-time stop/length finish
+            # counts as met.  Goodput credits FINAL-output tokens to the
+            # request's priority class (replayed prefill work earns nothing,
+            # same rule as the bench's goodput_tokens_per_sec).
+            if req.deadline is not None:
+                self._deadline_requests.inc()
+                if reason in ("stop", "length") and \
+                        lc.t_finish <= req.deadline:
+                    self._deadline_met.inc()
+            if reason in ("stop", "length") and token_ids:
+                prio = int(req.priority)
+                c = self._goodput_prio.get(prio)
+                if c is None:
+                    c = self.metrics.counter(
+                        f"goodput_tokens_priority_{prio}",
+                        f"final-output tokens from priority-{prio} requests")
+                    self._goodput_prio[prio] = c
+                c.inc(len(token_ids))
+        self._tev(rid, "finish", reason=reason, n_generated=len(token_ids))
         out = RequestOutput(req.request_id, req.prompt, token_ids, reason,
-                            cached, ttft, lc)
+                            cached, ttft, lc, self._traces.pop(rid, None))
         self._outputs[out.request_id] = out
+        if out.trace is not None and self._trace_retention is not None:
+            # bounded retirement ledger: drop the OLDEST retired timeline
+            # past the cap (the output itself keeps its tokens/metrics)
+            self._retired_traced.append(rid)
+            while len(self._retired_traced) > self._trace_retention:
+                old = self._outputs.get(self._retired_traced.popleft())
+                if old is not None:
+                    old.trace = None
         return out
 
     def _bucket_for(self, n: int) -> int:
@@ -1081,6 +1168,48 @@ class LLMEngine:
         if self._tracing or _prof.is_recording():
             return _prof.RecordEvent(name)
         return _NULL_SPAN
+
+    # ---- per-request tracing ----------------------------------------------
+    def _tev(self, rid: int, name: str, **attrs) -> None:
+        """Stamp one event on a request's timeline (no-op with tracing off or
+        for an unknown/finished rid).  Hot-path cost: one dict lookup, one
+        clock read, one dict+list append — plain host data, inside whatever
+        ENGINE_SPANS phase the caller already occupies (no new spans, no
+        device access, no compiled-program change)."""
+        tr = self._traces.get(rid)
+        if tr is not None:
+            tr.event(self._now(), name, **attrs)
+
+    def _exemplar(self, rid: int) -> Optional[Dict[str, str]]:
+        """Exemplar labels binding a histogram observation to its request:
+        the id plus the obs-server handle that resolves it
+        (``GET /requests/<rid>`` returns the chrome-trace span tree).  None
+        with request tracing off — the exposition then carries no exemplars,
+        matching the absent timelines."""
+        if not self._req_tracing:
+            return None
+        return {"request_id": str(rid), "trace": f"/requests/{rid}"}
+
+    def _trace_for(self, rid: int):
+        """The request's timeline, live (`_traces`) or retired (riding its
+        RequestOutput) — the single lookup behind `export_request_trace`
+        and the debug bundle's per-request states; None when the id is
+        unknown or tracing is off."""
+        tr = self._traces.get(rid)
+        if tr is None:
+            out = self._outputs.get(rid)
+            tr = out.trace if out is not None else None
+        return tr
+
+    def export_request_trace(self, rid: int) -> Optional[Dict[str, object]]:
+        """The chrome-trace span tree of one request's timeline (live or
+        retired — retired traces ride their RequestOutput, retained for the
+        last `trace_retention` retirements), or None when the id is unknown,
+        tracing is off, or the timeline aged out.  Served by the obs server
+        as ``GET /requests/<rid>``; the raw event list is
+        `RequestOutput.trace.events`."""
+        tr = self._trace_for(rid)
+        return None if tr is None else tr.to_chrome()
 
     # ---- scheduler --------------------------------------------------------
     def step(self) -> List[RequestOutput]:
@@ -1199,6 +1328,8 @@ class LLMEngine:
         n = min(self._chunk, lp - st.filled)
         job = {"slot": slot, "n": n, "q_offset": st.filled, "st": st,
                "done": st.filled + n == lp}
+        self._tev(st.request.request_id, "prefill_chunk",
+                  q_offset=int(st.filled), n=int(n))
         st.filled += n
         self._prefill_chunks.inc()
         self._prefilled_tokens.inc(n)
@@ -1344,6 +1475,8 @@ class LLMEngine:
             self._spec_drafted.inc(nd)
             self._spec_accepted.inc(a)
             self._spec_emitted.inc(len(emitted))
+            self._tev(seq.request.request_id, "spec_verify", drafted=int(nd),
+                      accepted=int(a), emitted=len(emitted))
             # adaptive spec back-off: a slot whose drafts are NEVER accepted
             # (acceptance rate ~0 over the window) stops paying the proposer
             # scan and the wasted candidate positions — it keeps riding the
@@ -1391,6 +1524,8 @@ class LLMEngine:
                     # policy and resolves the failure — and alone it always
                     # fits eventually (add_request rejected any footprint
                     # larger than the pool), so its replay cannot loop
+                    self._tev(self._running[slot].request.request_id,
+                              "grow_fail", need_tokens=int(need))
                     self._preempt_slot(self._pick_victim())
         for slot in list(drafts):
             if slot not in self._running:
@@ -1446,6 +1581,8 @@ class LLMEngine:
             # bench's swap-vs-recompute split must not claim it did
         else:
             self._preempt_recomputes.inc()
+        self._tev(rid, "preempt", kind=rec["kind"], pages=int(n),
+                  progress=len(seq.generated))
         self._preempted[rid] = rec
         lc = self._lifecycles.get(rid)
         if lc is not None:
@@ -1469,6 +1606,7 @@ class LLMEngine:
         rec["fetched"] = True
         self._swapped_pages_c.inc(rec["n"])
         self._preempt_swaps.inc()
+        self._tev(rec["rid"], "swap_out", pages=int(rec["n"]))
 
     def _degrade_to_recompute(self, rec: Dict[str, object]) -> None:
         """A swap whose d2h/h2d copy failed falls back to recompute: drop
@@ -1478,6 +1616,7 @@ class LLMEngine:
         rec.pop("data", None)
         self.cache.note_swap_in(rec["rid"])
         self._preempt_recomputes.inc()
+        self._tev(rec["rid"], "swap_degrade")
 
     def _drain_swap_d2h(self) -> None:
         """Materialize deferred swap-out fetches — called after the step's
@@ -1548,6 +1687,7 @@ class LLMEngine:
         self._swap_ms_c.inc((self._now() - t0) * 1e3)
         mgr.note_swap_in(rid)
         self._preempted.pop(rid)
+        self._tev(rid, "swap_in", slot=slot, pages=int(n))
         mgr.lengths[slot] = rec["L"]
         seq = _Running(req, slot, list(rec["generated"]),
                        rec["cached_tokens"], rec["ttft"],
@@ -1670,8 +1810,10 @@ class LLMEngine:
             if lc is not None and lc.t_admit is None:
                 lc.t_admit = self._now()
                 lc.queue_s = lc.t_admit - lc.t_enqueue
-                self._h_queue.observe(lc.queue_s)
+                self._h_queue.observe(lc.queue_s, exemplar=self._exemplar(rid))
                 lc.cached_tokens = matched
+            self._tev(rid, "admit", slot=slot, prefix_hit_tokens=int(matched),
+                      cow=cow is not None, resume=rec is not None)
             if rec is not None:
                 self._preempted.pop(rid)
                 self._recomputed_tokens.inc(lp - matched)
@@ -1695,6 +1837,7 @@ class LLMEngine:
             if not self.chunked and matched == 0:
                 # legacy one-shot bucketed prefill, synchronous at admission
                 bucket = self._bucket_for(lp)
+                self._tev(rid, "prefill", n=int(lp), bucket=int(bucket))
                 ids = np.zeros((1, bucket), np.int32)
                 ids[0, :lp] = prompt
                 pages = row[:bucket // mgr.page_size][None, :]
@@ -1735,6 +1878,8 @@ class LLMEngine:
         lp = st.prompt.size
         C = self._chunk
         n = min(C, lp - st.filled)
+        self._tev(st.request.request_id, "prefill_chunk",
+                  q_offset=int(st.filled), n=int(n))
         ids = np.zeros((1, C), np.int32)
         ids[0, :n] = st.prompt[st.filled:st.filled + n]
         with self._span("engine.prefill.dispatch"):
@@ -1786,7 +1931,9 @@ class LLMEngine:
             if lc is not None:
                 lc.t_first_token = now
                 lc.ttft_s = ttft
-            self._h_ttft.observe(ttft)
+            self._h_ttft.observe(ttft,
+                                 exemplar=self._exemplar(req.request_id))
+            self._tev(req.request_id, "first_token")
         seq = _Running(req, slot, generated, cached, ttft,
                        self._req_greedy(req))
         seq.spec_off = spec_off
@@ -2189,6 +2336,20 @@ class LLMEngine:
             "weight_dtype": self.weight_dtype,
             "kv_dtype": self.kv_dtype,
             "kv_pool_bytes": self.kv_pool_bytes(),
+            # SLO surface (PR-10 deadlines made end-to-end): attainment over
+            # every retired deadline-bearing request (timeouts/aborts are
+            # misses in the denominator, still excluded from the latency
+            # histograms) + final-output tokens per priority class
+            "slo": {
+                "deadline_requests": self._deadline_requests.value,
+                "deadline_met": self._deadline_met.value,
+                "deadline_attainment":
+                    self._deadline_met.value / self._deadline_requests.value
+                    if self._deadline_requests.value else None,
+                "goodput_tokens_by_priority":
+                    {p: c.value
+                     for p, c in sorted(self._goodput_prio.items())},
+            },
             # latency distributions (engine-side histograms; seconds) — the
             # serving SLO surface: benches report p50/p99 straight from here
             "latency": {
@@ -2199,3 +2360,110 @@ class LLMEngine:
                 "step_s": self._h_step.summary(),
             },
         }
+
+    # ---- postmortem debug bundle ------------------------------------------
+    def _request_states(self, finished_limit: int = 64) \
+            -> Dict[str, Dict[str, object]]:
+        """Per-request state map for the debug bundle: every live request
+        (queued — including preempted/swapped resumes waiting at the head —
+        prefilling, running) plus the last `finished_limit` retired ones,
+        each with its scheduler coordinates and its trace timeline (empty
+        with tracing off).  Keys are request-id strings (JSON object keys)."""
+        def base(req, state, **extra):
+            tr = self._trace_for(req.request_id)
+            d = {"state": state, "prompt_len": int(req.prompt.size),
+                 "max_new_tokens": int(req.max_new_tokens),
+                 "priority": int(req.priority),
+                 "deadline": req.deadline,
+                 "events": list(tr.events) if tr is not None else []}
+            d.update(extra)
+            return d
+
+        out: Dict[str, Dict[str, object]] = {}
+        # snapshot the live containers: an obs-server handler thread walks
+        # them concurrently with step()'s mutations, and iterating the deque/
+        # dicts directly would raise mid-scrape ("mutated during iteration")
+        for req in list(self._queue):
+            rec = self._preempted.get(req.request_id)
+            out[str(req.request_id)] = base(
+                req, "queued",
+                preempted_kind=None if rec is None else rec["kind"],
+                banked_tokens=0 if rec is None else len(rec["generated"]))
+        for slot, st in list(self._prefilling.items()):
+            out[str(st.request.request_id)] = base(
+                st.request, "prefilling", slot=slot, filled=int(st.filled),
+                effective_prompt_len=int(st.prompt.size))
+        for slot, seq in list(self._running.items()):
+            out[str(seq.request.request_id)] = base(
+                seq.request, "running", slot=slot,
+                n_generated=len(seq.generated),
+                kv_len=int(self.cache.lengths[slot]),
+                spec_off=seq.spec_off)
+        # last-N retired requests WITHOUT materializing the all-time output
+        # ledger (unbounded on a long-running server): walk the insertion
+        # order backwards, then flip to oldest-first
+        recent = list(itertools.islice(reversed(self._outputs), finished_limit))
+        for rid in reversed(recent):
+            o = self._outputs[rid]
+            out[str(rid)] = {
+                "state": "finished", "finish_reason": o.finish_reason,
+                "prompt_len": int(np.asarray(o.prompt).size),
+                "n_generated": len(o.token_ids),
+                "cached_tokens": int(o.cached_tokens),
+                "events": list(o.trace.events) if o.trace is not None else [],
+            }
+        return out
+
+    def debug_bundle(self, finished_limit: int = 64) -> Dict[str, object]:
+        """The postmortem snapshot the obs server serves as ``GET /debug``
+        and `dump_debug_bundle` writes to disk: engine/pool configuration,
+        page-partition levels, per-request states with their trace
+        timelines, the last-N step-trace ring, `stats()` and a full metrics
+        snapshot — everything "what was the engine doing when it died" needs,
+        all plain JSON (prompt/KV CONTENT deliberately excluded).  Safe to
+        call mid-flight: it reads host scheduler state only, no device sync,
+        no executable dispatch."""
+        mgr = self.cache
+        return {
+            "version": 1,
+            "t": self._now(),
+            "engine": {
+                "num_slots": mgr.num_slots, "page_size": mgr.page_size,
+                "num_pages": mgr.num_pages,
+                "max_model_len": self.max_model_len,
+                "prefill_chunk": self.prefill_chunk,
+                "spec_len": self.spec_len, "fused": self.fused,
+                "double_buffer": self.double_buffer,
+                "admission": self.admission, "preempt": self.preempt,
+                "mp": self.mp, "weight_dtype": self.weight_dtype,
+                "kv_dtype": self.kv_dtype,
+                "request_tracing": self._req_tracing,
+                "inflight": self._inflight is not None,
+            },
+            "pool": {
+                "pages_in_use": mgr.pages_in_use(),
+                "pages_free": mgr.num_free_pages,
+                "pages_evictable": mgr.num_evictable_pages,
+                "kv_pages_swapped": mgr.swapped_page_count,
+                "swapped_requests": mgr.swapped_requests,
+                "pool_pressure": round(mgr.pool_pressure(), 4),
+                "kv_pool_bytes": self.kv_pool_bytes(),
+                "swap_pool_pages": self.swap_pool_pages,
+            },
+            "requests": self._request_states(finished_limit),
+            "step_trace": self.step_trace(),
+            "stats": self.stats(),
+            "metrics": self.metrics.snapshot(),
+        }
+
+    def dump_debug_bundle(self, dir_name: str,
+                          finished_limit: int = 64) -> str:
+        """Write `debug_bundle()` to ``<dir_name>/debug_bundle.json`` and
+        return the path — `bench_serve.py` calls this automatically on a
+        crash or a drain-invariant failure, and operators call it on demand
+        (or hit the obs server's ``/debug``) for a live snapshot."""
+        os.makedirs(dir_name, exist_ok=True)
+        path = os.path.join(dir_name, "debug_bundle.json")
+        with open(path, "w") as f:
+            json.dump(self.debug_bundle(finished_limit), f)
+        return path

@@ -31,7 +31,24 @@ Export surfaces:
   bench JSON and `engine.trace()` dumps;
 - `to_prometheus()` — text exposition format (`# HELP` / `# TYPE` + samples,
   cumulative `_bucket{le=...}` rows ending at `+Inf`, `_sum`/`_count`), ready
-  for a scrape endpoint.  `tools/check_metrics.py` parses this output in CI.
+  for a scrape endpoint (`inference.obs_server` serves it on ``GET
+  /metrics``).  `tools/check_metrics.py` parses this output in CI.
+
+Two fleet-facing extensions (the dp-group router's input):
+- **Exemplars** — `Histogram.observe(v, exemplar={...labels...})` remembers,
+  per bucket, the labels of the latest observation that landed there
+  (the engine attaches ``{request_id, trace}``), and `to_prometheus()` emits
+  them in OpenMetrics ``# {label="v"} value`` exemplar syntax on the
+  ``_bucket`` line — so the request behind a p99 latency bucket is one
+  ``GET /requests/<rid>`` away from the scrape text itself.
+- **`merge()` / `FleetMetrics`** — fold N engines' registries into one
+  aggregate with per-type semantics (counters SUM; gauges fold by their
+  declared `agg` — sum for levels, max for ratio gauges — queue
+  depths and page levels add across replicas; histograms add bucket-wise
+  with min/max/count/sum folded and the last-merged exemplar kept per
+  bucket), while `FleetMetrics.to_prometheus()` re-exposes every member's
+  samples under an ``{engine="<label>"}`` label, grouped per metric family
+  so the exposition stays well-formed.
 """
 from __future__ import annotations
 
@@ -93,16 +110,26 @@ class Counter:
 class Gauge:
     """Instantaneous level: `set()` pushed, or `fn` pulled at read time (the
     engine registers pull gauges over cache/queue state so the scheduler hot
-    path never updates them)."""
+    path never updates them).
 
-    __slots__ = ("name", "help", "_value", "_fn")
+    `agg` declares how the gauge folds across a fleet merge: ``"sum"``
+    (default — queue depths and page levels add across replicas) or
+    ``"max"`` for ratio/fraction gauges like pool pressure, where a sum of
+    per-replica fractions is meaningless and the fleet-wide signal is the
+    worst member."""
+
+    __slots__ = ("name", "help", "_value", "_fn", "agg")
 
     def __init__(self, name: str, fn: Optional[Callable[[], float]] = None,
-                 help: str = ""):
+                 help: str = "", agg: str = "sum"):
+        if agg not in ("sum", "max"):
+            raise ValueError(f"gauge {name} agg must be 'sum' or 'max', "
+                             f"got {agg!r}")
         self.name = name
         self.help = help
         self._fn = fn
         self._value = 0.0
+        self.agg = agg
 
     def set(self, v: float) -> None:
         if self._fn is not None:
@@ -122,10 +149,17 @@ class Histogram:
     """Fixed-bucket histogram with le-semantics edges (`counts[i]` holds
     observations in `(edges[i-1], edges[i]]`; larger values land in the
     overflow bucket).  Tracks count/sum/min/max exactly; percentiles are
-    bucket-interpolated estimates."""
+    bucket-interpolated estimates.
+
+    `observe(v, exemplar={...})` additionally remembers `(labels, v)` for the
+    bucket v landed in — the LATEST observation per bucket wins (OpenMetrics
+    keeps one exemplar per bucket; the freshest is the debuggable one).
+    `reset()` clears exemplars with the counts: a handle pointing at a
+    request observed before the reset must not survive into an exposition
+    whose bucket counts say nothing was observed."""
 
     __slots__ = ("name", "help", "edges", "counts", "overflow",
-                 "count", "sum", "_min", "_max")
+                 "count", "sum", "_min", "_max", "exemplars")
 
     def __init__(self, name: str, buckets: Optional[Sequence[float]] = None,
                  help: str = ""):
@@ -146,14 +180,19 @@ class Histogram:
         self.sum = 0.0
         self._min = math.inf
         self._max = -math.inf
+        # one slot per bucket + the overflow bucket: (labels dict, value)
+        self.exemplars: List[Optional[tuple]] = [None] * (len(self.edges) + 1)
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float,
+                exemplar: Optional[Dict[str, str]] = None) -> None:
         v = float(v)
         i = bisect_left(self.edges, v)      # first edge >= v: the le bucket
         if i < len(self.edges):
             self.counts[i] += 1
         else:
             self.overflow += 1
+        if exemplar is not None:
+            self.exemplars[min(i, len(self.edges))] = (exemplar, v)
         self.count += 1
         self.sum += v
         if v < self._min:
@@ -226,12 +265,49 @@ def _fmt(v: float) -> str:
     return f"{v:.10g}"
 
 
+def _escape(v: str) -> str:
+    """Prometheus label-value escaping: backslash, quote, newline."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _render_labels(labels: Optional[Dict[str, str]],
+                   le: Optional[str] = None) -> str:
+    """`{k="v",...}` label block (extra labels first, `le` last), or ""."""
+    parts = [f'{_sanitize(k)}="{_escape(v)}"'
+             for k, v in (labels or {}).items()]
+    if le is not None:
+        parts.append(f'le="{le}"')
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _render_exemplar(ex: Optional[tuple], engine: Optional[str] = None) -> str:
+    """OpenMetrics exemplar suffix ``# {labels} value`` (empty when None).
+
+    `engine` is the fleet member label the sample is being re-exposed under:
+    request ids are per-engine (every member has a request 0), so a bare
+    ``/requests/<rid>`` trace handle is ambiguous fleet-wide — the handle
+    gets the member scoped on as ``?engine=<label>``, which the obs server's
+    fleet mode resolves to exactly that member's timeline."""
+    if ex is None:
+        return ""
+    labels, value = ex
+    if engine is not None and "trace" in labels:
+        labels = {**labels, "trace": f'{labels["trace"]}?engine={engine}'}
+    return f" # {_render_labels(labels) or '{}'} {_fmt(float(value))}"
+
+
 class MetricsRegistry:
     """Namespace of metrics sharing one injectable monotonic clock.
 
     Factory methods are idempotent per name (the same Counter comes back, so
     the engine and the cache manager can both ask for `prefix_evictions`);
-    asking for an existing name as a different type raises."""
+    asking for an existing name as a different type raises.
+
+    Readers (snapshot/exposition/merge) copy the metric map before iterating:
+    an obs-server thread scrapes concurrently with the engine thread lazily
+    registering counters (per-priority goodput), and iterating the live dict
+    would raise mid-scrape."""
 
     def __init__(self, namespace: str = "",
                  clock: Callable[[], float] = time.perf_counter):
@@ -259,8 +335,9 @@ class MetricsRegistry:
         return self._register(name, Counter, lambda: Counter(name, help))
 
     def gauge(self, name: str, fn: Optional[Callable[[], float]] = None,
-              help: str = "") -> Gauge:
-        return self._register(name, Gauge, lambda: Gauge(name, fn, help))
+              help: str = "", agg: str = "sum") -> Gauge:
+        return self._register(name, Gauge,
+                              lambda: Gauge(name, fn, help, agg))
 
     def histogram(self, name: str,
                   buckets: Optional[Sequence[float]] = None,
@@ -275,7 +352,7 @@ class MetricsRegistry:
         """Zero counters and histograms (set-gauges too; callback gauges read
         live state and have nothing to reset) — the engine's
         `reset_counters()` warmup-exclusion hook."""
-        for m in self._metrics.values():
+        for m in list(self._metrics.values()):
             m.reset()
 
     def snapshot(self) -> Dict[str, Dict[str, object]]:
@@ -283,7 +360,7 @@ class MetricsRegistry:
         summary dicts.  Callback gauges are evaluated here, once."""
         out: Dict[str, Dict[str, object]] = {"counters": {}, "gauges": {},
                                              "histograms": {}}
-        for name, m in self._metrics.items():
+        for name, m in list(self._metrics.items()):
             if isinstance(m, Counter):
                 out["counters"][name] = m.value
             elif isinstance(m, Gauge):
@@ -292,34 +369,206 @@ class MetricsRegistry:
                 out["histograms"][name] = m.summary()
         return out
 
-    def to_prometheus(self) -> str:
-        """Text exposition format, one block per metric: HELP/TYPE comments,
-        `_total` suffix on counters, cumulative `_bucket` rows ending at
-        `+Inf` plus `_sum`/`_count` on histograms."""
+    def _families(self, labels: Optional[Dict[str, str]] = None,
+                  exemplars: bool = True, openmetrics: bool = False):
+        """Yield one exposition family per metric: `(family_name, type,
+        help, [sample lines])`, with `labels` attached to every sample —
+        the shared core of `to_prometheus()` and `FleetMetrics`, which must
+        interleave several registries' samples per family to keep the
+        exposition grouped.  Counter samples always carry the `_total`
+        suffix; the FAMILY name (what HELP/TYPE lines cite) depends on the
+        dialect — OpenMetrics reserves the suffix for the sample and
+        forbids it on the MetricFamily (`# TYPE foo counter` + sample
+        `foo_total`; a strict parser rejects a `_total` family outright),
+        while legacy 0.0.4 text names the family as exposed."""
         ns = _sanitize(self.namespace + "_") if self.namespace else ""
-        lines: List[str] = []
-        for name, m in self._metrics.items():
+        lbl = _render_labels(labels)
+        eng = (labels or {}).get("engine")
+        for name, m in list(self._metrics.items()):
             full = ns + _sanitize(name)
             if isinstance(m, Counter):
                 tname = full if full.endswith("_total") else full + "_total"
-                if m.help:
-                    lines.append(f"# HELP {tname} {m.help}")
-                lines.append(f"# TYPE {tname} counter")
-                lines.append(f"{tname} {m.value}")
+                fam = tname[:-len("_total")] if openmetrics else tname
+                yield fam, "counter", m.help, [f"{tname}{lbl} {m.value}"]
             elif isinstance(m, Gauge):
-                if m.help:
-                    lines.append(f"# HELP {full} {m.help}")
-                lines.append(f"# TYPE {full} gauge")
-                lines.append(f"{full} {_fmt(m.value)}")
+                yield full, "gauge", m.help, [f"{full}{lbl} {_fmt(m.value)}"]
             else:
-                if m.help:
-                    lines.append(f"# HELP {full} {m.help}")
-                lines.append(f"# TYPE {full} histogram")
+                lines: List[str] = []
                 cum = 0
-                for edge, c in zip(m.edges, m.counts):
+                for i, (edge, c) in enumerate(zip(m.edges, m.counts)):
                     cum += c
-                    lines.append(f'{full}_bucket{{le="{_fmt(edge)}"}} {cum}')
-                lines.append(f'{full}_bucket{{le="+Inf"}} {m.count}')
-                lines.append(f"{full}_sum {_fmt(m.sum)}")
-                lines.append(f"{full}_count {m.count}")
+                    ex = (_render_exemplar(m.exemplars[i], eng)
+                          if exemplars else "")
+                    lines.append(
+                        f'{full}_bucket'
+                        f'{_render_labels(labels, le=_fmt(edge))} {cum}{ex}')
+                ex = (_render_exemplar(m.exemplars[-1], eng)
+                      if exemplars else "")
+                lines.append(f'{full}_bucket'
+                             f'{_render_labels(labels, le="+Inf")} '
+                             f'{m.count}{ex}')
+                lines.append(f"{full}_sum{lbl} {_fmt(m.sum)}")
+                lines.append(f"{full}_count{lbl} {m.count}")
+                yield full, "histogram", m.help, lines
+
+    def to_prometheus(self, labels: Optional[Dict[str, str]] = None,
+                      exemplars: Optional[bool] = None,
+                      openmetrics: bool = False) -> str:
+        """Text exposition format, one block per metric: HELP/TYPE comments,
+        `_total` suffix on counters, cumulative `_bucket` rows ending at
+        `+Inf` plus `_sum`/`_count` on histograms.  Histogram buckets carry
+        their latest exemplar in OpenMetrics ``# {labels} value`` syntax;
+        `labels` attaches a constant label set to every sample (how
+        `FleetMetrics` scopes a member engine).  `openmetrics=True` names
+        counter FAMILIES without the reserved `_total` suffix (samples keep
+        it) as the OpenMetrics spec requires — a strict parser rejects a
+        `_total` MetricFamily outright.
+
+        `exemplars` defaults to FOLLOW the dialect: the ``# {...}`` suffix is
+        OpenMetrics-only syntax that a stock 0.0.4 text parser rejects, so a
+        bare `to_prometheus()` stays pure legacy text a naive scraper can
+        consume, and `openmetrics=True` carries the exemplars.  Pass it
+        explicitly to override either way (the tests round-trip exemplars
+        through the legacy-named dialect that way)."""
+        if exemplars is None:
+            exemplars = openmetrics
+        lines: List[str] = []
+        for full, mtype, help_, samples in self._families(labels, exemplars,
+                                                          openmetrics):
+            if help_:
+                lines.append(f"# HELP {full} {help_}")
+            lines.append(f"# TYPE {full} {mtype}")
+            lines.extend(samples)
         return "\n".join(lines) + "\n"
+
+    def merge(self, other: "MetricsRegistry") -> "MetricsRegistry":
+        """Fold `other`'s CURRENT values into this registry, in place, with
+        per-type semantics (the fleet-aggregation primitive — build a fresh
+        aggregate registry and merge each member into it):
+
+        - **counter**: sum;
+        - **gauge**: folded by the gauge's declared `agg` over the values
+          read NOW — ``"sum"`` for fleet queue depths and page levels,
+          ``"max"`` for ratio gauges like pool pressure, where a sum of
+          per-replica fractions reads >100% on a healthy fleet and the
+          meaningful aggregate is the worst member (`other`'s callback
+          gauges are evaluated here and land as plain set-gauges in the
+          aggregate; a callback gauge on the AGGREGATE side cannot absorb
+          a merge and raises);
+        - **histogram**: bucket-wise count add (edges must match exactly),
+          overflow/count/sum added, min/max folded, and per bucket the
+          last-merged exemplar wins (matching `observe`'s latest-wins rule).
+
+        Metrics absent on one side pass through (a disjoint merge is a
+        union); a name registered as different types on the two sides
+        raises TypeError.  Returns self so merges chain."""
+        for name, m in list(other._metrics.items()):
+            if isinstance(m, Counter):
+                self.counter(name, m.help).inc(m.value)
+            elif isinstance(m, Gauge):
+                g = self.gauge(name, help=m.help, agg=m.agg)
+                if g.agg != m.agg:      # like mismatched histogram edges:
+                    raise ValueError(   # refuse loudly, don't fold garbage
+                        f"gauge {name!r} agg differs: aggregate folds by "
+                        f"{g.agg!r}, member declares {m.agg!r}")
+                g.set(max(g.value, m.value) if g.agg == "max"
+                      else g.value + m.value)
+            else:
+                h = self.histogram(name, m.edges, m.help)
+                if h.edges != m.edges:
+                    raise ValueError(
+                        f"histogram {name!r} bucket edges differ: "
+                        f"{h.edges} vs {m.edges}")
+                for i, c in enumerate(m.counts):
+                    h.counts[i] += c
+                h.overflow += m.overflow
+                h.count += m.count
+                h.sum += m.sum
+                h._min = min(h._min, m._min)
+                h._max = max(h._max, m._max)
+                for i, ex in enumerate(m.exemplars):
+                    if ex is not None:
+                        h.exemplars[i] = ex
+        return self
+
+
+class FleetMetrics:
+    """Aggregates N engines' registries — the dp-group router's input.
+
+    Members register under a label (`add("e0", engine_or_registry)`); the two
+    views are:
+
+    - `merged()` — a fresh `MetricsRegistry` (namespace ``llm_fleet``) built
+      by `MetricsRegistry.merge()` over every member: counters summed,
+      gauges folded by their declared `agg` (sum / max),
+      histograms bucket-wise added.  `snapshot()` returns
+      ``{"fleet": <merged snapshot>, "engines": {label: snapshot}}``.
+    - `to_prometheus()` — every member's samples re-exposed under an
+      ``{engine="<label>"}`` label, interleaved per metric family (all
+      samples of one name stay grouped under one TYPE comment, as the
+      exposition format requires), exemplars intact.  The merged totals ride
+      along as ``llm_fleet_*`` families — a different namespace, so the
+      per-engine series are never double-counted by an aggregating scraper.
+
+    Registration accepts an engine (anything with a `.metrics` registry —
+    `stats()`/`debug_bundle()` owners are kept for the obs server's fleet
+    endpoints) or a bare `MetricsRegistry`."""
+
+    def __init__(self):
+        self.registries: "OrderedDict[str, MetricsRegistry]" = OrderedDict()
+        self.engines: "OrderedDict[str, object]" = OrderedDict()
+
+    def add(self, label: str, member) -> "FleetMetrics":
+        reg = getattr(member, "metrics", member)
+        if not isinstance(reg, MetricsRegistry):
+            raise TypeError(f"member {label!r} is neither a MetricsRegistry "
+                            f"nor an engine exposing one, got {type(member)}")
+        self.registries[str(label)] = reg
+        self.engines[str(label)] = member if reg is not member else None
+        return self
+
+    def merged(self) -> MetricsRegistry:
+        agg = MetricsRegistry(namespace="llm_fleet")
+        for reg in self.registries.values():
+            agg.merge(reg)
+        return agg
+
+    def snapshot(self) -> Dict[str, object]:
+        return {
+            "fleet": self.merged().snapshot(),
+            "engines": {label: reg.snapshot()
+                        for label, reg in self.registries.items()},
+        }
+
+    def to_prometheus(self, exemplars: Optional[bool] = None,
+                      openmetrics: bool = False) -> str:
+        if exemplars is None:       # follow the dialect, as the registry does
+            exemplars = openmetrics
+        lines: List[str] = []
+        # per-engine series, grouped per metric family across members
+        families: "OrderedDict[str, tuple]" = OrderedDict()
+        samples: Dict[str, List[str]] = {}
+        for label, reg in self.registries.items():
+            for full, mtype, help_, fam_lines in reg._families(
+                    {"engine": label}, exemplars, openmetrics):
+                if full not in families:
+                    families[full] = (mtype, help_)
+                    samples[full] = []
+                elif families[full][0] != mtype:
+                    raise TypeError(
+                        f"metric {full!r} exposed as {families[full][0]} by "
+                        f"one engine and {mtype} by another")
+                samples[full].extend(fam_lines)
+        for full, (mtype, help_) in families.items():
+            if help_:
+                lines.append(f"# HELP {full} {help_}")
+            lines.append(f"# TYPE {full} {mtype}")
+            lines.extend(samples[full])
+        # fleet totals under their own namespace (no double counting)
+        merged = self.to_prometheus_merged(exemplars, openmetrics)
+        return "\n".join(lines) + ("\n" + merged if merged else "\n")
+
+    def to_prometheus_merged(self, exemplars: Optional[bool] = None,
+                             openmetrics: bool = False) -> str:
+        return self.merged().to_prometheus(exemplars=exemplars,
+                                           openmetrics=openmetrics)

@@ -11,9 +11,12 @@ import jax
 
 from paddle_tpu.models import gpt as G
 from paddle_tpu.inference.engine import ENGINE_SPANS, LLMEngine
-from paddle_tpu.inference.metrics import (Counter, Gauge, Histogram,
-                                          MetricsRegistry, log_buckets)
+from paddle_tpu.inference.faults import FaultPlan
+from paddle_tpu.inference.metrics import (Counter, FleetMetrics, Gauge,
+                                          Histogram, MetricsRegistry,
+                                          log_buckets)
 from paddle_tpu.inference.spec import NgramProposer
+from paddle_tpu.inference.tracing import RequestTrace
 
 
 # ---------------------------------------------------------------------------
@@ -275,7 +278,10 @@ def test_counters_monotonic_across_abort_and_eviction(spec_eng):
             assert eng.abort(rids[-1])
         cur = eng.metrics.snapshot()["counters"]
         for k, v in cur.items():
-            assert v >= prev[k], f"counter {k} decreased: {prev[k]} -> {v}"
+            # lazily registered counters (per-priority goodput) appear
+            # mid-run at 0 — appearing is fine, decreasing is not
+            assert v >= prev.get(k, 0), \
+                f"counter {k} decreased: {prev.get(k, 0)} -> {v}"
         prev = cur
     st = eng.stats()
     assert st["aborted_requests"] >= 1
@@ -415,6 +421,10 @@ NEW_STATS_KEYS = frozenset({
 }) | frozenset({
     # added by the quantized-serving PR (weight/kv int8 + intake admission)
     "weight_dtype", "kv_dtype", "kv_pool_bytes", "intake_swap_rejects",
+}) | frozenset({
+    # added by the observability-plane PR (SLO block: deadline attainment +
+    # per-priority-class goodput — the router's SLO layer input)
+    "slo",
 })
 
 
@@ -430,6 +440,549 @@ def test_stats_keyset_backcompat_golden(spec_eng):
     for summ in lat.values():
         assert set(summ) == {"count", "sum", "mean", "min", "max",
                              "p50", "p90", "p99"}
+
+
+# ---------------------------------------------------------------------------
+# per-request tracing: chrome export + exemplar round-trip (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+def test_request_trace_chrome_export_phases():
+    """Pure-host chrome rendering: lifecycle stamps become the root span +
+    queued/prefill/decode phase children with exact (relative-us) geometry;
+    every raw event rides along as an instant."""
+    tr = RequestTrace(7)
+    tr.event(1.0, "enqueue", prompt_len=4)
+    tr.event(2.0, "admit", slot=0)
+    tr.event(3.0, "first_token")
+    tr.event(5.0, "finish", reason="stop", n_generated=2)
+    tree = tr.to_chrome()
+    json.dumps(tree)                            # serializable as-is
+    evs = tree["traceEvents"]
+    spans = {e["name"]: e for e in evs if e["ph"] == "X"}
+    assert spans["request/7"]["dur"] == pytest.approx(4e6)
+    assert spans["queued"]["ts"] == 0.0
+    assert spans["queued"]["dur"] == pytest.approx(1e6)
+    assert spans["prefill"]["ts"] == pytest.approx(1e6)
+    assert spans["prefill"]["dur"] == pytest.approx(1e6)
+    assert spans["decode"]["ts"] == pytest.approx(2e6)
+    assert spans["decode"]["dur"] == pytest.approx(2e6)
+    instants = [e for e in evs if e["ph"] == "i"]
+    assert len(instants) == len(tr.events)
+    assert instants[0]["args"] == {"prompt_len": 4}
+    assert all(e["tid"] == 7 for e in evs)      # one track per request
+    # a phase never reached is absent: abort while queued has only "queued"
+    tr2 = RequestTrace(8)
+    tr2.event(1.0, "enqueue")
+    tr2.event(2.0, "finish", reason="abort")
+    names = {e["name"] for e in tr2.to_chrome()["traceEvents"]
+             if e["ph"] == "X"}
+    assert names == {"request/8", "queued"}
+    # empty timeline renders a valid empty tree (never KeyErrors)
+    assert RequestTrace(9).to_chrome() == {"traceEvents": [],
+                                           "displayTimeUnit": "ms"}
+
+
+def test_exemplar_roundtrip_exposition_to_request(tiny):
+    """observe -> exposition -> parse -> rid: every exemplar in the live
+    exposition carries the obs-server handle and resolves through
+    export_request_trace to the request's own span tree."""
+    from tools.check_metrics import check_exposition, parse_prometheus_full
+    cfg, params = tiny
+    clk = FakeClock(5.0)
+    eng = LLMEngine(params, cfg, num_slots=2, page_size=8, max_model_len=64,
+                    clock=clk)
+    rid = eng.add_request(np.arange(6, dtype=np.int32), max_new_tokens=3)
+    clk.t = 6.0
+    out = eng.run()[rid]
+    names = [e["name"] for e in out.trace.events]
+    assert names[0] == "enqueue" and names[-1] == "finish"
+    assert "admit" in names and "first_token" in names
+    text = eng.metrics.to_prometheus(exemplars=True)
+    errs = []
+    check_exposition(text, errs)
+    assert not errs, errs
+    _, exemplars = parse_prometheus_full(text)
+    assert exemplars, "no exemplar in the exposition"
+    for (name, _), (lbls, _v) in exemplars.items():
+        assert name.endswith("_bucket")
+        assert lbls["trace"] == f'/requests/{lbls["request_id"]}'
+        tree = eng.export_request_trace(int(lbls["request_id"]))
+        assert tree is not None and tree["traceEvents"]
+    assert rid in {int(l["request_id"]) for l, _ in exemplars.values()}
+    # the resolved tree is the chrome rendering of the same timeline
+    tnames = {e["name"]
+              for e in eng.export_request_trace(rid)["traceEvents"]}
+    assert {f"request/{rid}", "queued", "prefill", "decode",
+            "enqueue", "finish"} <= tnames
+    # exemplars follow the dialect by default: the `# {...}` suffix is
+    # OpenMetrics-only syntax, so a bare to_prometheus() is pure 0.0.4 a
+    # stock parser can scrape, and explicit exemplars=False strips them
+    # from any dialect
+    assert " # {" not in eng.metrics.to_prometheus()
+    assert " # {" in eng.metrics.to_prometheus(openmetrics=True)
+    assert " # {" not in eng.metrics.to_prometheus(openmetrics=True,
+                                                   exemplars=False)
+
+
+def test_request_tracing_off_strips_surface(tiny):
+    """request_tracing=False: no timelines, no /requests resolution, no
+    exemplars — but every histogram still observes (the A/B axis the bench's
+    <2% overhead bar runs on)."""
+    from tools.check_metrics import parse_prometheus_full
+    cfg, params = tiny
+    eng = LLMEngine(params, cfg, num_slots=2, page_size=8, max_model_len=64,
+                    request_tracing=False)
+    rid = eng.add_request(np.arange(6, dtype=np.int32), max_new_tokens=3)
+    out = eng.run()[rid]
+    assert out.trace is None
+    assert eng.export_request_trace(rid) is None
+    samples, exemplars = parse_prometheus_full(
+        eng.metrics.to_prometheus(exemplars=True))
+    assert not exemplars        # none to emit even when asked for
+    assert samples["llm_engine_ttft_seconds_count"][0][1] >= 1
+
+
+def test_trace_retention_bounds_retired_timelines(tiny):
+    """`trace_retention` caps how many RETIRED timelines the output ledger
+    holds: past the cap the oldest retired trace drops (its RequestOutput
+    keeps its tokens and metrics), newer ones keep resolving — the bound
+    that keeps an always-on plane from growing host memory forever on a
+    long-running server.  None retains everything."""
+    cfg, params = tiny
+    eng = LLMEngine(params, cfg, num_slots=2, page_size=8, max_model_len=64,
+                    trace_retention=2)
+    rids = [eng.add_request(np.arange(4 + i, dtype=np.int32),
+                            max_new_tokens=2) for i in range(3)]
+    outs = eng.run()
+    # 3 retirements, cap 2: the oldest timeline dropped, the rest resolve
+    assert eng.export_request_trace(rids[0]) is None
+    assert eng.export_request_trace(rids[1])["traceEvents"]
+    assert eng.export_request_trace(rids[2])["traceEvents"]
+    # the evicted request's OUTPUT survives, tokens intact
+    assert outs[rids[0]].finish_reason in ("stop", "length")
+    assert outs[rids[0]].trace is None
+    assert len(outs[rids[0]].token_ids) >= 1
+    eng2 = LLMEngine(params, cfg, num_slots=2, page_size=8, max_model_len=64,
+                     trace_retention=None)
+    r2 = [eng2.add_request(np.arange(4, dtype=np.int32), max_new_tokens=2)
+          for _ in range(3)]
+    eng2.run()
+    assert all(eng2.export_request_trace(x) is not None for x in r2)
+    with pytest.raises(ValueError, match="trace_retention"):
+        LLMEngine(params, cfg, num_slots=1, page_size=8, max_model_len=64,
+                  trace_retention=-1)
+
+
+@pytest.mark.parametrize("mode", ["swap", "recompute"])
+def test_timeline_exact_across_preempt_resume(tiny, mode):
+    """Fake-clock exactness through a forced preempt/resume cycle, both
+    eviction policies: a swap victim restores in place (swap_out -> swap_in,
+    no re-admission), a recompute victim re-enters through a second
+    admit(resume=True); stamps ride the engine clock monotonically and the
+    survivor's timeline stays preemption-free."""
+    cfg, params = tiny
+    clk = FakeClock(100.0)
+    eng = LLMEngine(params, cfg, num_slots=2, page_size=8, max_model_len=64,
+                    prefill_chunk=8, admission="optimistic", preempt=mode,
+                    clock=clk, fault_plan=FaultPlan(pressure_steps=(4,)))
+    lo = eng.add_request(np.arange(4, dtype=np.int32), max_new_tokens=20,
+                         priority=0)
+    hi = eng.add_request(np.arange(4, 6, dtype=np.int32), max_new_tokens=20,
+                         priority=1)
+    while eng.has_work:
+        clk.t += 1.0
+        eng.step()
+    st = eng.stats()
+    assert st["preemptions"] >= 1
+    ev = eng._outputs[lo].trace.events
+    names = [e["name"] for e in ev]
+    assert names[0] == "enqueue" and ev[0]["t"] == 100.0
+    assert names[-1] == "finish" and ev[-1]["reason"] == "length"
+    assert ev[-1]["n_generated"] == len(eng._outputs[lo].token_ids)
+    for key in ("grow_fail", "preempt", "first_token"):
+        assert key in names, f"missing {key}: {names}"
+    assert ev[names.index("preempt")]["kind"] == mode
+    if mode == "swap":
+        assert st["preempt_swaps"] >= 1
+        assert "swap_out" in names and "swap_in" in names
+        assert names.index("preempt") < names.index("swap_out") \
+            < names.index("swap_in")
+        assert "slot" in ev[names.index("swap_in")]
+        assert names.count("admit") == 1    # in-place restore, no re-admit
+    else:
+        assert "swap_out" not in names and "swap_in" not in names
+        assert names.count("admit") == 2    # first admission + replay
+        admits = [e for e in ev if e["name"] == "admit"]
+        assert admits[0]["resume"] is False and admits[1]["resume"] is True
+        assert names.index("preempt") < names.index("admit", 1 +
+                                                    names.index("admit"))
+    ts = [e["t"] for e in ev]
+    assert ts == sorted(ts)                 # engine clock is the only stamp
+    # survivor: admitted once, never preempted
+    hi_names = [e["name"] for e in eng._outputs[hi].trace.events]
+    assert "preempt" not in hi_names and hi_names.count("admit") == 1
+    # post-retirement resolution still works (trace rides the output)
+    assert eng.export_request_trace(lo)["traceEvents"]
+
+
+def test_timeline_and_slo_across_timeout(tiny):
+    """Deadline expiry: the timeline closes with finish(reason=timeout)
+    stamped at the expiry-scan clock; SLO accounting lands the miss in the
+    attainment denominator while the latency histograms keep excluding it;
+    goodput credits final tokens to the finisher's priority class only."""
+    cfg, params = tiny
+    clk = FakeClock(10.0)
+    eng = LLMEngine(params, cfg, num_slots=2, page_size=8, num_pages=17,
+                    max_model_len=64, clock=clk, double_buffer=False)
+    ok = eng.add_request(np.arange(5, dtype=np.int32), max_new_tokens=3,
+                         priority=1, deadline_s=1000.0)
+    clk.t = 11.0
+    eng.run()
+    late = eng.add_request(np.arange(7, dtype=np.int32), max_new_tokens=50,
+                           deadline_s=5.0)
+    eng.step()                              # admitted, decoding
+    e2e_before = eng.stats()["latency"]["e2e_s"]["count"]
+    clk.t = 40.0                            # far past enqueue + 5s
+    eng.step()
+    out = eng._outputs[late]
+    assert out.finish_reason == "timeout"
+    fin = out.trace.events[-1]
+    assert fin["name"] == "finish" and fin["reason"] == "timeout"
+    assert fin["t"] == 40.0
+    slo = eng.stats()["slo"]
+    assert slo["deadline_requests"] == 2 and slo["deadline_met"] == 1
+    assert slo["deadline_attainment"] == pytest.approx(0.5)
+    assert slo["goodput_tokens_by_priority"] == {1: 3}
+    # timeouts stay excluded from the latency SLO histograms
+    assert eng.stats()["latency"]["e2e_s"]["count"] == e2e_before
+
+
+def test_reset_counters_mid_trace_window(tiny, tmp_path):
+    """The audited reset-vs-open-capture contract (engine.reset_counters
+    docstring): a reset inside an engine.trace window neither corrupts the
+    chrome export nor leaves a stale exemplar handle — cleared exemplars
+    vanish from the exposition, and post-reset observations re-attach
+    handles that resolve."""
+    from tools.check_metrics import parse_prometheus_full
+    cfg, params = tiny
+    eng = LLMEngine(params, cfg, num_slots=2, page_size=8, max_model_len=64)
+    td = tmp_path / "trace"
+    with eng.trace(str(td), device=False):
+        eng.add_request(np.arange(5, dtype=np.int32), max_new_tokens=3)
+        eng.run()
+        _, exemplars = parse_prometheus_full(
+            eng.metrics.to_prometheus(exemplars=True))
+        assert exemplars                    # attached pre-reset
+        eng.reset_counters()
+        # exemplars cleared WITH the counts: no handle survives a reset
+        _, exemplars = parse_prometheus_full(
+            eng.metrics.to_prometheus(exemplars=True))
+        assert not exemplars
+        rid2 = eng.add_request(np.arange(7, dtype=np.int32),
+                               max_new_tokens=3)
+        eng.run()
+    # the chrome export survived the mid-window reset
+    host = json.loads((td / "host_trace.json").read_text())
+    assert host["traceEvents"]
+    assert all(e["ph"] == "X" and e["dur"] >= 0 for e in host["traceEvents"])
+    # step timeline holds only post-reset records (warmup-exclusion
+    # semantics), and stays valid JSON
+    timeline = json.loads((td / "step_timeline.json").read_text())
+    assert timeline and all("step" in r for r in timeline)
+    # post-reset exemplars point at post-reset requests only, and resolve
+    _, exemplars = parse_prometheus_full(
+        eng.metrics.to_prometheus(exemplars=True))
+    rids = {int(l["request_id"]) for l, _ in exemplars.values()}
+    assert rids == {rid2}
+    assert eng.export_request_trace(rid2)["traceEvents"]
+
+
+# ---------------------------------------------------------------------------
+# fleet aggregation: merge math + labeled re-exposition (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+def test_registry_merge_counter_histogram_goldens():
+    """merge() vs hand-computed goldens: counter sum, gauge fold by its
+    declared agg (sum for levels, MAX for ratio gauges — a sum of
+    per-replica fractions would read >100% on a healthy fleet), histogram
+    bucket-wise add with min/max/count/sum folded and last-merged exemplar
+    per bucket; disjoint names union; empty merges are identities."""
+    a = MetricsRegistry(namespace="m")
+    b = MetricsRegistry(namespace="m")
+    a.counter("c").inc(3)
+    b.counter("c").inc(4)
+    b.counter("only_b").inc(5)
+    a.gauge("g").set(2.0)
+    b.gauge("g").set(0.5)
+    a.gauge("pressure", agg="max").set(0.3)
+    b.gauge("pressure", agg="max").set(0.7)
+    ha = a.histogram("h", buckets=[1.0, 2.0])
+    hb = b.histogram("h", buckets=[1.0, 2.0])
+    ha.observe(0.5, exemplar={"request_id": "1"})
+    ha.observe(1.5)
+    hb.observe(1.7, exemplar={"request_id": "9"})
+    hb.observe(9.0)
+    agg = MetricsRegistry(namespace="m").merge(a).merge(b)
+    snap = agg.snapshot()
+    assert snap["counters"] == {"c": 7, "only_b": 5}
+    assert snap["gauges"]["g"] == pytest.approx(2.5)
+    assert snap["gauges"]["pressure"] == pytest.approx(0.7)   # max, not 1.0
+    with pytest.raises(ValueError, match="agg"):
+        MetricsRegistry().gauge("bad", agg="mean")
+    h = agg.get("h")
+    assert h.counts == [1, 2] and h.overflow == 1
+    assert h.count == 4 and h.sum == pytest.approx(0.5 + 1.5 + 1.7 + 9.0)
+    assert h.min == 0.5 and h.max == 9.0
+    assert h.exemplars[0] == ({"request_id": "1"}, 0.5)
+    assert h.exemplars[1] == ({"request_id": "9"}, 1.7)   # last-merged wins
+    assert h.exemplars[2] is None
+    # empty-registry identities, both directions
+    empty = MetricsRegistry(namespace="m")
+    assert empty.merge(MetricsRegistry(namespace="m")).snapshot() == \
+        MetricsRegistry(namespace="m").snapshot()
+    assert MetricsRegistry(namespace="m").merge(a).snapshot() == a.snapshot()
+    before = agg.snapshot()
+    assert agg.merge(MetricsRegistry(namespace="m")).snapshot() == before
+
+
+def test_exemplar_label_escape_roundtrip():
+    """Label values survive exposition escaping byte-for-byte — including
+    the adversarial cases for ordered .replace unescaping (a literal
+    backslash before 'n', escaped quotes, real newlines)."""
+    from tools.check_metrics import parse_prometheus_full
+    tricky = 'back\\slash "quote" bs-n\\nreal\nnewline'
+    reg = MetricsRegistry()
+    reg.histogram("h", buckets=[1.0]).observe(0.5, exemplar={"v": tricky})
+    _, exemplars = parse_prometheus_full(reg.to_prometheus(exemplars=True))
+    (labels, value), = exemplars.values()
+    assert labels == {"v": tricky}
+    assert value == 0.5
+
+
+def test_registry_merge_conflicts_raise():
+    """Mismatched bucket edges, name/type conflicts and a callback gauge on
+    the aggregate side all refuse loudly instead of merging garbage."""
+    a = MetricsRegistry()
+    a.histogram("h", buckets=[1.0, 2.0]).observe(0.5)
+    bad_edges = MetricsRegistry()
+    bad_edges.histogram("h", buckets=[1.0, 3.0])
+    with pytest.raises(ValueError, match="bucket edges differ"):
+        bad_edges.merge(a)
+    bad_type = MetricsRegistry()
+    bad_type.gauge("h").set(1.0)
+    with pytest.raises(TypeError):
+        bad_type.merge(a)
+    live = MetricsRegistry()
+    live.gauge("g", lambda: 7)              # callback gauge: read-only
+    src = MetricsRegistry()
+    src.gauge("g").set(1.0)
+    with pytest.raises(ValueError):
+        live.merge(src)
+    # but a callback gauge on the SOURCE side merges by value
+    agg = MetricsRegistry()
+    agg.merge(live)
+    assert agg.get("g").value == 7
+
+
+def test_fleet_metrics_exposition_and_snapshot():
+    """FleetMetrics over two registries (one disjoint metric): per-engine
+    labeled series grouped per family, llm_fleet_* totals equal to the
+    member sums, and the whole exposition passes the CI checker."""
+    from tools.check_metrics import check_exposition, parse_prometheus
+    r0 = MetricsRegistry(namespace="llm_engine")
+    r1 = MetricsRegistry(namespace="llm_engine")
+    r0.counter("decode_tokens").inc(10)
+    r1.counter("decode_tokens").inc(32)
+    r1.counter("only_e1").inc(2)
+    r0.histogram("ttft_seconds", buckets=[0.1, 1.0]).observe(
+        0.05, exemplar={"request_id": "3", "trace": "/requests/3"})
+    r1.histogram("ttft_seconds", buckets=[0.1, 1.0]).observe(0.5)
+    fleet = FleetMetrics().add("e0", r0).add("e1", r1)
+    text = fleet.to_prometheus(exemplars=True)
+    errs = []
+    check_exposition(text, errs)
+    assert not errs, errs
+    samples = parse_prometheus(text)
+    per = dict(samples["llm_engine_decode_tokens_total"])
+    assert per == {'{engine="e0"}': 10, '{engine="e1"}': 32}
+    assert samples["llm_fleet_decode_tokens_total"][0][1] == 42
+    assert dict(samples["llm_engine_only_e1_total"]) == {'{engine="e1"}': 2}
+    assert samples["llm_fleet_only_e1_total"][0][1] == 2
+    assert samples["llm_fleet_ttft_seconds_count"][0][1] == 2
+    # member exemplars survive the labeled re-exposition, with the trace
+    # handle scoped to the member (request ids are per-engine counters)
+    assert 'request_id="3"' in text
+    assert 'trace="/requests/3?engine=e0"' in text
+    # and the default fleet exposition follows the dialect: no exemplars
+    assert " # {" not in fleet.to_prometheus()
+    snap = fleet.snapshot()
+    assert set(snap) == {"fleet", "engines"}
+    assert set(snap["engines"]) == {"e0", "e1"}
+    assert snap["fleet"]["counters"]["decode_tokens"] == 42
+    assert snap["engines"]["e0"]["counters"]["decode_tokens"] == 10
+    with pytest.raises(TypeError):
+        FleetMetrics().add("x", object())
+
+
+# ---------------------------------------------------------------------------
+# HTTP observability plane + postmortem debug bundle (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+def _http_get(url, accept=None):
+    import urllib.error
+    import urllib.request
+    req = urllib.request.Request(
+        url, headers={"Accept": accept} if accept else {})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, r.read().decode("utf-8")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode("utf-8")
+
+
+def test_obs_server_endpoint_smoke(tiny):
+    """All five routes over a real loopback socket on an ephemeral port:
+    /metrics parses with exemplars, /stats carries the SLO block,
+    /requests/<rid> serves the span tree (404 unknown, 400 malformed),
+    /debug is a valid bundle, /healthz answers — and close() actually tears
+    the daemon-thread listener down."""
+    import urllib.error
+
+    from paddle_tpu.inference.obs_server import ObservabilityServer
+    from tools.check_metrics import (REQUIRED_DEBUG_BUNDLE_KEYS,
+                                     check_exposition, parse_prometheus_full)
+    cfg, params = tiny
+    eng = LLMEngine(params, cfg, num_slots=2, page_size=8, max_model_len=64)
+    rid = eng.add_request(np.arange(6, dtype=np.int32), max_new_tokens=3)
+    eng.run()
+    with ObservabilityServer(eng) as srv:
+        assert srv.port > 0 and srv.url.startswith("http://127.0.0.1:")
+        # OpenMetrics negotiation: exemplars + # EOF on the wire
+        code, text = _http_get(srv.url + "/metrics",
+                               accept="application/openmetrics-text")
+        assert code == 200 and text.endswith("# EOF\n")
+        errs = []
+        check_exposition(text, errs)
+        assert not errs, errs
+        assert parse_prometheus_full(text)[1]       # exemplars on the wire
+        # plain scrape: 0.0.4 text, exemplar-free (stock Prometheus rejects
+        # the suffix outside openmetrics mode)
+        code, plain = _http_get(srv.url + "/metrics")
+        assert code == 200 and " # {" not in plain
+        assert "# EOF" not in plain
+        errs = []
+        check_exposition(plain, errs)
+        assert not errs, errs
+        code, text = _http_get(srv.url + "/stats")
+        assert code == 200 and "slo" in json.loads(text)
+        code, text = _http_get(srv.url + f"/requests/{rid}")
+        assert code == 200 and json.loads(text)["traceEvents"]
+        assert _http_get(srv.url + "/requests/424242")[0] == 404
+        assert _http_get(srv.url + "/requests/nope")[0] == 400
+        assert _http_get(srv.url + "/nosuch")[0] == 404
+        code, text = _http_get(srv.url + "/healthz")
+        assert code == 200 and json.loads(text) == {"ok": True}
+        code, text = _http_get(srv.url + "/debug")
+        assert code == 200
+        assert REQUIRED_DEBUG_BUNDLE_KEYS <= set(json.loads(text))
+        url = srv.url
+    with pytest.raises((ConnectionError, urllib.error.URLError)):
+        _http_get(url + "/healthz")
+
+
+def test_obs_server_fleet_mode(tiny):
+    """Fleet mode: /metrics re-exposes members under engine labels plus
+    llm_fleet totals, /stats and /debug key by member label, and
+    /requests/<rid> disambiguates colliding per-engine request ids —
+    ?engine= (what fleet exemplar handles carry) scopes the lookup, a bare
+    colliding rid gets 300 with the candidate handles instead of an
+    arbitrary member's timeline.  Constructor rejects ambiguous
+    engine+fleet wiring."""
+    from paddle_tpu.inference.obs_server import ObservabilityServer
+    cfg, params = tiny
+    e0 = LLMEngine(params, cfg, num_slots=1, page_size=8, max_model_len=64)
+    e1 = LLMEngine(params, cfg, num_slots=1, page_size=8, max_model_len=64)
+    # SAME rid on both members: per-engine counters both start at 0
+    rid0 = e0.add_request(np.arange(7, dtype=np.int32), max_new_tokens=2)
+    e0.run()
+    rid = e1.add_request(np.arange(5, dtype=np.int32), max_new_tokens=2)
+    e1.run()
+    assert rid0 == rid
+    fleet = FleetMetrics().add("e0", e0).add("e1", e1)
+    with ObservabilityServer(fleet=fleet) as srv:
+        code, text = _http_get(srv.url + "/metrics",
+                               accept="application/openmetrics-text")
+        assert code == 200
+        assert 'engine="e0"' in text and 'engine="e1"' in text
+        assert "llm_fleet_" in text
+        # fleet exemplar handles are member-scoped, and resolve as served
+        assert f'trace="/requests/{rid}?engine=e1"' in text
+        def enqueue_prompt_len(tree):
+            enq = [e for e in tree["traceEvents"] if e["name"] == "enqueue"]
+            return enq[0]["args"]["prompt_len"]
+
+        code, text = _http_get(srv.url + f"/requests/{rid}?engine=e1")
+        assert code == 200 and enqueue_prompt_len(json.loads(text)) == 5
+        code, text = _http_get(srv.url + f"/requests/{rid}?engine=e0")
+        assert code == 200 and enqueue_prompt_len(json.loads(text)) == 7
+        # a bare colliding rid is ambiguous: candidates, not a silent guess
+        code, text = _http_get(srv.url + f"/requests/{rid}")
+        assert code == 300
+        body = json.loads(text)
+        assert body["engines"] == ["e0", "e1"]
+        assert f"/requests/{rid}?engine=e1" in body["handles"]
+        assert _http_get(srv.url + f"/requests/{rid}?engine=nosuch")[0] == 404
+        code, text = _http_get(srv.url + "/stats")
+        st = json.loads(text)
+        assert code == 200 and set(st) == {"e0", "e1"}
+        assert st["e1"]["finished_requests"] == 1
+        code, text = _http_get(srv.url + "/debug")
+        assert code == 200 and set(json.loads(text)) == {"e0", "e1"}
+    with pytest.raises(ValueError):
+        ObservabilityServer(e0, fleet=fleet)
+    with pytest.raises(ValueError):
+        ObservabilityServer()
+
+
+def test_debug_bundle_valid_after_forced_fault_crash(tiny, tmp_path):
+    """bench_serve's crash hook, reproduced at the engine API: a hard (non-
+    degradable) fault escapes step() mid-flight with rich scheduler state,
+    and dump_debug_bundle still writes a valid, schema-complete JSON
+    postmortem — request states with timelines, step ring, pool levels."""
+    from tools.check_metrics import REQUIRED_DEBUG_BUNDLE_KEYS
+    cfg, params = tiny
+
+    class _HardFault(FaultPlan):
+        # a non-FaultInjected error cannot be degraded to recompute: it
+        # escapes the engine exactly like a real d2h wreck would
+        def d2h(self):
+            raise RuntimeError("hard d2h crash")
+
+    eng = LLMEngine(params, cfg, num_slots=6, page_size=8, num_pages=9,
+                    max_model_len=64, prefill_chunk=8,
+                    admission="optimistic", preempt="swap",
+                    fault_plan=_HardFault(pressure_steps=(3,)))
+    rng = np.random.RandomState(7)
+    for n in (5, 9, 14, 20, 6, 11):
+        eng.add_request(rng.randint(0, cfg.vocab_size, (n,)).astype(np.int32),
+                        max_new_tokens=24)
+    with pytest.raises(RuntimeError, match="hard d2h crash"):
+        while eng.has_work:
+            eng.step()
+    path = eng.dump_debug_bundle(str(tmp_path / "bundle"))
+    with open(path) as f:
+        bundle = json.load(f)
+    assert REQUIRED_DEBUG_BUNDLE_KEYS <= set(bundle)
+    assert bundle["engine"]["request_tracing"] is True
+    reqs = bundle["requests"]
+    assert reqs, "no request states in the postmortem"
+    states = {r["state"] for r in reqs.values()}
+    assert states <= {"queued", "prefilling", "running", "finished"}
+    assert any(r["events"] for r in reqs.values())
+    assert bundle["step_trace"] and isinstance(bundle["step_trace"], list)
+    assert isinstance(bundle["pool"]["pages_in_use"], int)
+    assert "slo" in bundle["stats"]
+    assert bundle["metrics"]["counters"]["preemptions"] >= 1
 
 
 def test_check_metrics_tool(tmp_path):

@@ -8,19 +8,34 @@ run so a future PR cannot silently drop a key, break the exposition format,
 or make a "counter" go backwards:
 
 - **stats() schema** — every key in REQUIRED_STATS_KEYS present (the frozen
-  serving-stats surface, including the latency histogram block);
+  serving-stats surface, including the latency histogram block and the SLO
+  block: deadline attainment + per-priority goodput);
 - **registry schema** — required counters/gauges/histograms present in
   `metrics.snapshot()`;
 - **exposition** — `to_prometheus()` parses line-by-line against the
-  Prometheus text format: HELP/TYPE comments only, well-formed samples,
-  `_bucket` series cumulative and ending at `+Inf` == `_count`;
+  Prometheus text format: HELP/TYPE comments only, well-formed samples
+  (general label sets accepted), `_bucket` series cumulative and ending at
+  `+Inf` == `_count`, and OpenMetrics `# {...} value` exemplars syntactically
+  valid with the exemplar value inside its bucket's `le` bound;
+- **exemplar round-trip** — the smoke engine's exposition carries >= 1
+  exemplar whose `request_id` resolves through
+  `engine.export_request_trace()` to a non-empty chrome-trace span tree (the
+  p99-to-request lookup the tracing layer exists for);
+- **merged-registry schema** — `MetricsRegistry.merge()` counter/histogram
+  math against hand-computed goldens, and a two-member `FleetMetrics`
+  exposition that parses with per-engine labels plus `llm_fleet_*` totals
+  equal to the member sums;
+- **obs-server smoke** — `ObservabilityServer` over the live smoke engine on
+  an ephemeral loopback port: /metrics parses under this same checker,
+  /stats carries the required keys, /requests/<rid> serves the exemplar's
+  span tree, /debug is valid JSON with the bundle schema;
 - **monotonicity** — across a CPU-smoke engine loop that exercises admission,
   chunked prefill, speculative verify, prefix hits, LRU eviction AND abort,
   no counter ever decreases between steps;
 - **program budget** — decode-side compiled programs within the budget
   declared in paddle_tpu/analysis/registry.py with metrics enabled
-  (observability is host-only; see tools/check_program_count.py for the
-  full per-mesh budget).
+  (observability — tracing and exemplars included — is host-only; see
+  tools/check_program_count.py for the full per-mesh budget).
 
 Exits non-zero with a diff on violation.  Usage:
     JAX_PLATFORMS=cpu python tools/check_metrics.py
@@ -55,6 +70,13 @@ REQUIRED_STATS_KEYS = frozenset({
     # quantized serving (ISSUE 11): the quantization knobs, the at-rest pool
     # bytes the capacity math keys on, and the swap-pool intake gate counter
     "weight_dtype", "kv_dtype", "kv_pool_bytes", "intake_swap_rejects",
+    # observability-plane PR (ISSUE 12): the SLO block (deadline attainment
+    # + per-priority-class goodput) the router's SLO layer consumes
+    "slo",
+})
+REQUIRED_SLO_KEYS = frozenset({
+    "deadline_requests", "deadline_met", "deadline_attainment",
+    "goodput_tokens_by_priority",
 })
 REQUIRED_LATENCY_KEYS = frozenset(
     {"queue_s", "ttft_s", "tpot_s", "e2e_s", "step_s"})
@@ -66,7 +88,11 @@ REQUIRED_COUNTERS = frozenset({
     "finished_requests", "aborted_requests", "prefix_evictions",
     "preemptions", "preempt_swaps", "preempt_recomputes", "swapped_pages",
     "swap_ms", "recomputed_tokens", "timeouts", "rejected_requests",
-    "intake_swap_rejects",
+    "intake_swap_rejects", "deadline_requests", "deadline_met",
+})
+REQUIRED_DEBUG_BUNDLE_KEYS = frozenset({
+    "version", "t", "engine", "pool", "requests", "step_trace", "stats",
+    "metrics",
 })
 REQUIRED_GAUGES = frozenset({
     "queued", "prefilling", "running", "kv_pages_in_use", "kv_pages_free",
@@ -78,21 +104,58 @@ REQUIRED_HISTOGRAMS = frozenset({
     "e2e_latency_seconds", "step_seconds",
 })
 
+# general Prometheus label set: {k="v",...} with escaped quotes/backslashes
+_LABELSET = r'\{(?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"' \
+            r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*)?\}'
+_NUM = r"(?:-?(?:[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?|Inf|NaN)|\+Inf)"
 _SAMPLE = re.compile(
-    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"              # metric name
-    r'(\{le="[^"]+"\})?'                        # optional le label (hist)
-    r" (-?(?:[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?|Inf|NaN)|\+Inf)$")
+    rf"^([a-zA-Z_:][a-zA-Z0-9_:]*)"             # metric name
+    rf"({_LABELSET})?"                          # optional label set
+    rf" ({_NUM})"                               # sample value
+    rf"(?: # ({_LABELSET}) ({_NUM})(?: ({_NUM}))?)?$")  # OpenMetrics exemplar
 _COMMENT = re.compile(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+$")
+_LABEL_ITEM = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
 
 
-def parse_prometheus(text):
-    """Minimal exposition-format checker: returns {name: [(labels, value)]},
-    raising ValueError on any malformed line."""
+def parse_labels(labelset):
+    """`{k="v",...}` (or ""/None) -> dict, unescaping values.  Unescaping is
+    a single left-to-right pass (each backslash consumes exactly the next
+    char) — sequential .replace calls would mis-decode a literal backslash
+    followed by 'n' or a quote."""
+    out = {}
+    for k, v in _LABEL_ITEM.findall(labelset or ""):
+        out[k] = re.sub(r"\\(.)",
+                        lambda m: "\n" if m.group(1) == "n" else m.group(1),
+                        v)
+    return out
+
+
+def series_key(labelset):
+    """Grouping key for a sample's label set with the `le` bucket label
+    removed: PARSED and re-serialized sorted, not regex-stripped — a label
+    KEY that merely ends in "le" (``module=...``) must survive, and bucket
+    rows must key identically to their `_count`/`_sum` rows regardless of
+    label order."""
+    items = sorted((k, v) for k, v in parse_labels(labelset).items()
+                   if k != "le")
+    return "{%s}" % ",".join(f'{k}="{v}"' for k, v in items) if items else ""
+
+
+def parse_prometheus_full(text):
+    """Exposition parser: returns `(samples, exemplars)` where samples is
+    {name: [(labels, value)]} and exemplars is {(name, labels): (exemplar
+    label dict, exemplar value)} for every sample carrying an OpenMetrics
+    `# {...} value [ts]` exemplar suffix.  Raises ValueError on any
+    malformed line — including a malformed exemplar, which the pre-exemplar
+    parser would have rejected wholesale and a naive split would ignore."""
     samples = {}
+    exemplars = {}
     for line in text.splitlines():
         if not line.strip():
             continue
-        if line.startswith("#"):
+        if line == "# EOF":        # OpenMetrics terminator (obs server)
+            continue
+        if line.startswith("#") and not line.startswith("# {"):
             if not _COMMENT.match(line):
                 raise ValueError(f"malformed comment line: {line!r}")
             continue
@@ -101,30 +164,66 @@ def parse_prometheus(text):
             raise ValueError(f"malformed sample line: {line!r}")
         name, labels, value = m.group(1), m.group(2) or "", m.group(3)
         samples.setdefault(name, []).append((labels, float(value)))
-    return samples
+        if m.group(4) is not None:
+            if not (name.endswith("_bucket") or name.endswith("_total")):
+                raise ValueError(
+                    f"exemplar on a non-bucket/counter sample: {line!r}")
+            exemplars[(name, labels)] = (parse_labels(m.group(4)),
+                                         float(m.group(5)))
+    return samples, exemplars
+
+
+def parse_prometheus(text):
+    """Minimal exposition-format checker: returns {name: [(labels, value)]},
+    raising ValueError on any malformed line (exemplar-tolerant; use
+    parse_prometheus_full to read the exemplars too)."""
+    return parse_prometheus_full(text)[0]
 
 
 def check_exposition(text, errors):
     try:
-        samples = parse_prometheus(text)
+        samples, exemplars = parse_prometheus_full(text)
     except ValueError as e:
         errors.append(str(e))
         return
     for base in (n[:-len("_bucket")] for n in samples if n.endswith("_bucket")):
         buckets = samples[base + "_bucket"]
-        counts = [v for _, v in buckets]
-        if counts != sorted(counts):
-            errors.append(f"{base}_bucket series is not cumulative: {counts}")
-        if buckets[-1][0] != '{le="+Inf"}':
-            errors.append(f"{base}_bucket does not end at le=+Inf")
-        count = samples.get(base + "_count")
-        if count is None:
-            errors.append(f"{base}_count sample missing")
-        elif count[0][1] != counts[-1]:
-            errors.append(f"{base}: +Inf bucket {counts[-1]} != "
-                          f"_count {count[0][1]}")
+        # fleet expositions carry one series per {engine=...} label set:
+        # cumulative/+Inf/_count checks apply per series, keyed on the
+        # labels with `le` stripped
+        series = {}
+        for labels, v in buckets:
+            series.setdefault(series_key(labels), []).append((labels, v))
+        for key, rows in series.items():
+            counts = [v for _, v in rows]
+            tag = f"{base}_bucket{key or ''}"
+            if counts != sorted(counts):
+                errors.append(f"{tag} series is not cumulative: {counts}")
+            if 'le="+Inf"' not in rows[-1][0]:
+                errors.append(f"{tag} does not end at le=+Inf")
+            count = [v for lbl, v in samples.get(base + "_count", ())
+                     if series_key(lbl) == key]
+            if not count:
+                errors.append(f"{base}_count sample missing for {key or '{}'}")
+            elif count[0] != counts[-1]:
+                errors.append(f"{tag}: +Inf bucket {counts[-1]} != "
+                              f"_count {count[0]}")
         if base + "_sum" not in samples:
             errors.append(f"{base}_sum sample missing")
+    # exemplar semantics: a bucket's exemplar value must sit within its le
+    # bound (our histograms attach the exemplar to the bucket the value
+    # landed in, so a violation means attachment or emission broke)
+    for (name, labels), (ex_labels, ex_value) in exemplars.items():
+        if not name.endswith("_bucket"):
+            continue
+        le = parse_labels(labels).get("le")
+        if le is None:
+            errors.append(f"exemplar on a bucket without le: {name}{labels}")
+            continue
+        bound = float("inf") if le == "+Inf" else float(le)
+        if ex_value > bound:
+            errors.append(f"exemplar value {ex_value} above its bucket "
+                          f'bound le="{le}" on {name}{labels}')
 
 
 def run_smoke(errors):
@@ -180,6 +279,154 @@ def run_smoke(errors):
     return eng, st
 
 
+def check_exemplar_roundtrip(eng, errors):
+    """>= 1 exemplar in the live exposition, and its request_id resolves
+    through export_request_trace to a non-empty chrome span tree — the
+    aggregate-to-request lookup the tracing layer exists for.  Returns the
+    resolved rid (for the obs-server smoke) or None."""
+    try:
+        _, exemplars = parse_prometheus_full(
+            eng.metrics.to_prometheus(exemplars=True))
+    except ValueError as e:
+        errors.append(f"exposition with exemplars failed to parse: {e}")
+        return None
+    rids = sorted({ex[0]["request_id"] for ex in exemplars.values()
+                   if "request_id" in ex[0]})
+    if not rids:
+        errors.append("no request_id exemplar in the smoke exposition "
+                      "(request tracing defaulted off, or attachment broke)")
+        return None
+    rid = int(rids[0])
+    tree = eng.export_request_trace(rid)
+    if not (isinstance(tree, dict) and tree.get("traceEvents")):
+        errors.append(f"exemplar request {rid} did not resolve to a "
+                      f"chrome-trace span tree (got {type(tree).__name__})")
+        return None
+    names = {e.get("name") for e in tree["traceEvents"]}
+    if f"request/{rid}" not in names or "enqueue" not in names:
+        errors.append(f"request {rid} span tree missing root/enqueue: "
+                      f"{sorted(names)}")
+    return rid
+
+
+def check_merge_and_fleet(eng, errors):
+    """MetricsRegistry.merge math vs hand-computed goldens + a two-member
+    FleetMetrics exposition (per-engine labels, llm_fleet_* totals == member
+    sums) parsed under this file's own checker."""
+    from paddle_tpu.inference.metrics import FleetMetrics, MetricsRegistry
+
+    a, b = MetricsRegistry(namespace="m"), MetricsRegistry(namespace="m")
+    a.counter("c").inc(3)
+    b.counter("c").inc(4)
+    b.counter("only_b").inc(5)                  # disjoint-name passthrough
+    ha = a.histogram("h", [1.0, 2.0])
+    hb = b.histogram("h", [1.0, 2.0])
+    ha.observe(0.5, exemplar={"request_id": "1"})
+    hb.observe(1.5)
+    hb.observe(9.0)
+    agg = MetricsRegistry(namespace="agg").merge(a).merge(b)
+    snap = agg.snapshot()
+    if snap["counters"].get("c") != 7 or snap["counters"].get("only_b") != 5:
+        errors.append(f"counter merge != golden: {snap['counters']}")
+    h = agg.get("h")
+    if h.counts != [1, 1] or h.overflow != 1 or h.count != 3 or \
+            h.sum != 11.0 or h.min != 0.5 or h.max != 9.0:
+        errors.append(f"histogram merge != golden: counts={h.counts} "
+                      f"overflow={h.overflow} count={h.count} sum={h.sum}")
+    # fleet: the same engine twice => per-engine labels + exactly-2x totals
+    fleet = FleetMetrics().add("e0", eng).add("e1", eng)
+    text = fleet.to_prometheus()
+    check_exposition(text, errors)
+    try:
+        samples = parse_prometheus(text)
+    except ValueError as e:
+        errors.append(f"fleet exposition failed to parse: {e}")
+        return
+    per = {lbl: v
+           for lbl, v in samples.get("llm_engine_decode_tokens_total", ())}
+    if set(per) != {'{engine="e0"}', '{engine="e1"}'}:
+        errors.append(f"fleet per-engine labels wrong: {sorted(per)}")
+    total = samples.get("llm_fleet_decode_tokens_total", [("", -1)])[0][1]
+    if total != sum(per.values()) or total != \
+            2 * eng.stats()["decode_tokens"]:
+        errors.append(f"fleet merged total {total} != member sum "
+                      f"{sum(per.values())}")
+    # exemplar-carrying fleet text still parses, and every PER-ENGINE series
+    # exemplar scopes its trace handle with ?engine= — request ids are
+    # per-engine counters, so an unscoped handle is ambiguous fleet-wide
+    # (the llm_fleet_* merged series keep the member's bare handle: the obs
+    # server answers those with the candidate list rather than guessing)
+    try:
+        _, fex = parse_prometheus_full(fleet.to_prometheus(exemplars=True))
+    except ValueError as e:
+        errors.append(f"fleet exposition with exemplars failed to parse: {e}")
+        return
+    if not fex:
+        errors.append("fleet exposition carries no exemplar")
+    unscoped = [(name, labels) for (name, labels), ex in fex.items()
+                if 'engine="' in labels and "trace" in ex[0]
+                and "?engine=" not in ex[0]["trace"]]
+    if unscoped:
+        errors.append(f"fleet per-engine exemplar trace handles missing "
+                      f"?engine= scope: {unscoped[:3]}")
+
+
+def check_obs_server(eng, rid, errors):
+    """Endpoint smoke over a real loopback socket (ephemeral port, daemon
+    thread): /metrics parses, /stats carries the stats schema, /requests/
+    <rid> serves the exemplar's span tree, /debug is a valid bundle, and an
+    unknown rid is a clean 404."""
+    import urllib.error
+    import urllib.request
+
+    from paddle_tpu.inference.obs_server import ObservabilityServer
+
+    def get(srv, route, accept=None):
+        req = urllib.request.Request(
+            srv.url + route,
+            headers={"Accept": accept} if accept else {})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status, r.read().decode("utf-8")
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode("utf-8")
+
+    with ObservabilityServer(eng) as srv:
+        # OpenMetrics negotiation carries the exemplars...
+        status, text = get(srv, "/metrics",
+                           accept="application/openmetrics-text")
+        if status != 200:
+            errors.append(f"/metrics -> {status}")
+        check_exposition(text, errors)
+        if not parse_prometheus_full(text)[1]:
+            errors.append("/metrics (openmetrics) carries no exemplar")
+        # ...while a plain 0.0.4 scrape must get exemplar-free text (stock
+        # Prometheus text-format parsers reject the suffix)
+        status, plain = get(srv, "/metrics")
+        if status != 200:
+            errors.append(f"/metrics (plain) -> {status}")
+        check_exposition(plain, errors)
+        if " # {" in plain:
+            errors.append("plain /metrics scrape leaked exemplar syntax")
+        status, text = get(srv, "/stats")
+        st = json.loads(text) if status == 200 else {}
+        missing = REQUIRED_STATS_KEYS - set(st)
+        if status != 200 or missing:
+            errors.append(f"/stats -> {status}, missing {sorted(missing)}")
+        if rid is not None:
+            status, text = get(srv, f"/requests/{rid}")
+            if status != 200 or not json.loads(text).get("traceEvents"):
+                errors.append(f"/requests/{rid} -> {status} (no span tree)")
+        status, text = get(srv, "/requests/1234567")
+        if status != 404:
+            errors.append(f"/requests/<unknown> -> {status}, want 404")
+        status, text = get(srv, "/debug")
+        bundle = json.loads(text) if status == 200 else {}
+        missing = REQUIRED_DEBUG_BUNDLE_KEYS - set(bundle)
+        if status != 200 or missing:
+            errors.append(f"/debug -> {status}, missing {sorted(missing)}")
+
+
 def main() -> int:
     errors = []
     eng, st = run_smoke(errors)
@@ -191,6 +438,9 @@ def main() -> int:
         lat_missing = REQUIRED_LATENCY_KEYS - set(st["latency"])
         if lat_missing:
             errors.append(f"stats()['latency'] missing: {sorted(lat_missing)}")
+        slo_missing = REQUIRED_SLO_KEYS - set(st["slo"])
+        if slo_missing:
+            errors.append(f"stats()['slo'] missing: {sorted(slo_missing)}")
 
     snap = eng.metrics.snapshot()
     for section, required in (("counters", REQUIRED_COUNTERS),
@@ -205,6 +455,9 @@ def main() -> int:
         errors.append(f"snapshot() is not JSON-serializable: {e}")
 
     check_exposition(eng.metrics.to_prometheus(), errors)
+    rid = check_exemplar_roundtrip(eng, errors)
+    check_merge_and_fleet(eng, errors)
+    check_obs_server(eng, rid, errors)
 
     # observability must be free of compiled programs: decode-side budget
     # unchanged — the bound comes from the registry (declared ONCE) so this
@@ -222,6 +475,7 @@ def main() -> int:
               "prefix_evictions": st["prefix_evictions"],
               "spec_events": st["spec_events"],
               "aborted_requests": st["aborted_requests"],
+              "exemplar_rid": rid,
               "errors": errors}
     for e in errors:
         print(f"FAIL: {e}", file=sys.stderr)
