@@ -71,6 +71,12 @@ the engine's host phases + per-step timeline + metrics dump.  Host-side
 only — a jax device capture over a whole bench run would dominate the timed
 section; for a device timeline, wrap a short window in `engine.trace(dir)`
 directly (device capture is its default).
+
+Every run appends ONE schema-versioned row (mode axes + key perf metrics +
+parity flags) to `BENCH_SERVE.jsonl` — the serving perf trajectory across
+PRs, validated and CI-floor-enforced by `tools/check_bench.py` (`--ci` runs
+a fresh smoke bench against `SERVE_PERF_FLOORS` from the analysis registry).
+`--no-history` opts out.
 """
 from __future__ import annotations
 
@@ -334,9 +340,13 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
     # model_error = measured/predicted; on TPU the dispatch is device-bound
     # and the ratio is meaningful, on the CPU smoke host scheduling
     # dominates and it is only sanity-bounded.
-    from paddle_tpu.analysis.cost_model import device_spec, engine_step_cost
+    from paddle_tpu.analysis.cost_model import device_spec
     dspec = device_spec()
-    predicted_ms = engine_step_cost(eng).predicted_ms(dspec, mp=eng.mp)
+    # `predicted_step_ms` is the engine's own cached roofline (armed by
+    # warm_decode above, through the SAME engine_step_cost account
+    # tools/tpu_cost.py prints) — the live roofline_drift gauge divides by
+    # exactly this number, so the bench and the gauge cannot disagree
+    predicted_ms = eng.predicted_step_ms
     measured_ms = (sum(r["dur_s"] for r in busy) / len(busy) * 1e3
                    if busy else 0.0)
     # deterministic tracing-cost account: wall-clock A/Bs on a shared CI box
@@ -403,6 +413,13 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
         "model_error": round(measured_ms / predicted_ms, 3)
                        if predicted_ms > 0 else None,
         "device_spec": dspec.name,
+        # live signal plane (health & signals PR): the steady-state drift
+        # gauge (EWMA measured / predicted — the run-long average above is
+        # the bench's number, this is what a scrape would see), recompile
+        # anomalies, and the health state the run drained at
+        "roofline_drift": st["roofline"]["drift"],
+        "steady_state_recompiles": st["roofline"]["steady_state_recompiles"],
+        "health_state": st["health"]["state"],
         "decode_tokens_per_sec_per_chip": round(decode_tokens / dt / n_chips, 1),
         "generated_tokens_per_sec": round(num_requests * max_new_tokens / dt, 1),
         # goodput: tokens that made it into FINAL outputs per second —
@@ -548,6 +565,14 @@ def main():
                          "pair (2 passes, like the spec/fuse comparison "
                          "passes).  Raise it on a noisy shared box where a "
                          "single adjacent-pair ratio drifts several %%")
+    ap.add_argument("--no-history", action="store_true",
+                    help="do not append this run's trajectory row to "
+                         "BENCH_SERVE.jsonl (the default run records one: "
+                         "mode axes + key perf metrics, schema-checked and "
+                         "CI-enforced by tools/check_bench.py)")
+    ap.add_argument("--history", type=str, default=None,
+                    help="trajectory file to append to (default: "
+                         "BENCH_SERVE.jsonl next to this script)")
     ap.add_argument("--debug-bundle-dir", type=str, default="serve_debug",
                     help="where a crash or drain-invariant failure writes "
                          "the postmortem debug bundle ('' disables)")
@@ -729,6 +754,17 @@ def main():
     # per-request streams fed the agreement score above; the digest already
     # fingerprints them, so keep the JSON line bounded
     stats.pop("output_tokens", None)
+    if not args.no_history:
+        # the serving trajectory: one schema-versioned row per run (mode
+        # axes + key perf metrics) appended AFTER every comparison pass so
+        # fused_speedup/parity land in it — tools/check_bench.py owns the
+        # row shape, validates it here, and --ci enforces the declared
+        # SERVE_PERF_FLOORS against a fresh run
+        from tools.check_bench import DEFAULT_HISTORY, append_bench_row
+        path = args.history or DEFAULT_HISTORY
+        append_bench_row(stats, path=path)
+        print(f"[bench_serve] trajectory row appended to {path}",
+              file=sys.stderr)
     print(json.dumps({"metric": metric,
                       "value": stats["decode_tokens_per_sec_per_chip"],
                       "unit": "tokens/s/chip", **stats}))

@@ -160,6 +160,101 @@ SERVE_RESOURCE_BUDGET: Dict[str, object] = {
 }
 
 
+# ---------------------------------------------------------------------------
+# serving SLO + health thresholds (consumed by inference/health.py, the obs
+# server's /healthz and tools/check_metrics.py)
+# ---------------------------------------------------------------------------
+
+# The engine's health evaluation folds the live signal plane — multi-window
+# SLO burn rates, pool pressure, admission saturation (timeout/reject rates),
+# preemption rate, steady-state recompile anomalies — into ONE
+# ok/degraded/overloaded state with per-signal reasons, against the targets
+# declared HERE (and only here: the /healthz probe, stats()["health"], the
+# `engine_health` gauge and the health tests all read this dict).  The
+# numbers are the audit/CPU-smoke config's yardstick, same convention as
+# SERVE_RESOURCE_BUDGET; a real deployment re-declares them for its traffic.
+SERVE_SLO: Dict[str, object] = {
+    # deadline-attainment target: the SLO the burn rates measure against.
+    # Burn = (windowed miss fraction) / (1 - target): burn 1.0 consumes the
+    # error budget exactly as fast as allowed, >1 is on track to violate.
+    "deadline_attainment_target": 0.99,
+    # latency bounds on the engine-side lifecycle histograms (p99, ms):
+    # crossing one degrades health (the engine still serves; a router should
+    # prefer other replicas).  Sized for the CPU-smoke/audit config — a cold
+    # compile inside a first request's TTFT legitimately trips it.
+    "ttft_p99_ms": 2000.0,
+    "tpot_p99_ms": 500.0,
+    # device KV pool pressure (pages in use / usable pages) at or above this
+    # fraction degrades health: admission is about to stall and preemption
+    # is imminent — the router should stop sending work here first.
+    "pressure_ceiling": 0.95,
+    # multi-window burn: page only when the FAST window burns hot while the
+    # SLOW window confirms it is not a blip (the classic two-window rule).
+    # Labels index inference.metrics.RATE_WINDOWS.
+    "burn_window_fast": "1m",
+    "burn_window_slow": "5m",
+    "burn_degraded": 1.0,       # either window at 1.0 = budget-speed burn
+    "burn_overloaded": 10.0,    # fast >= 10 x budget AND slow confirming
+    # preemption churn (preemptions/s over the fast 10s window): sustained
+    # preemption means live tokens exceed pool capacity — degraded at the
+    # first trickle, overloaded when victims are evicted every second.
+    "preempt_rate_degraded": 0.1,
+    "preempt_rate_overloaded": 1.0,
+    # admission saturation: ANY deadline timeout or intake rejection inside
+    # the fast 10s window degrades; timeouts at or above this rate mean the
+    # engine is shedding load faster than it serves — overloaded.
+    "timeout_rate_overloaded": 1.0,
+    # acceptable band for measured/predicted step time (the live roofline
+    # drift gauge).  Wide because it must hold on CPU-smoke hosts where
+    # dispatch overhead dominates; on TPU the ratio sits near 1 and a
+    # tighter operational band belongs in the deployment's alert config.
+    # Excursions count alert TRANSITIONS (roofline_drift_alerts counter),
+    # they do not fold into engine_health (a slow host is not an overload).
+    "roofline_drift_band": (0.02, 50.0),
+}
+
+# ---------------------------------------------------------------------------
+# serving-bench perf floors (consumed by tools/check_bench.py --ci)
+# ---------------------------------------------------------------------------
+
+# The serving-bench trajectory (`BENCH_SERVE.jsonl`, appended by
+# bench_serve.py / tools/check_bench.py) is CI-enforced the same way the
+# HBM/program budgets are: floors declared ONCE here, re-measured on a fresh
+# CPU-smoke bench run by `tools/check_bench.py --ci`.  Wall-clock numbers on
+# a shared CI box swing +-10%, so the floors bind the DETERMINISTIC side of
+# the bench (byte parity, dispatch counts, the stamp-count tracing account)
+# tightly and the wall-clock ratios loosely.
+SERVE_PERF_FLOORS: Dict[str, object] = {
+    "schema_version": 1,
+    # every parity flag a bench run reports must be True — byte-exact greedy
+    # parity is the one bar noise cannot excuse
+    "parity_flags": ("fuse_parity", "spec_parity", "oversubscribe_parity",
+                     "tracing_parity"),
+    # the one-dispatch claim in numbers: a fused busy step dispatches
+    # exactly ONE decode-side program — tied to the program budget above so
+    # the two guards cannot drift apart
+    "dispatches_per_step_max": float(
+        SERVE_PROGRAM_BUDGET["decode_side_executables"]),
+    # fused-vs-unfused tokens/s ratio.  The fused win is a TPU claim
+    # (dispatch overhead is what fusion removes); on this shared CPU-smoke
+    # box the measured ratio hovers ~0.89-1.46 run-over-run depending on
+    # load and mode, so the floor only catches a COLLAPSE (a fused path
+    # suddenly dispatching extra work), not the win itself — byte parity
+    # and dispatches_per_step carry the deterministic side of the claim.
+    "fused_speedup_min": 0.8,
+    # the always-on tracing plane's deterministic stamp-count x unit-cost
+    # account (bench `tracing_overhead_measured`) must stay under 2%
+    "tracing_overhead_max": 0.02,
+    # roofline sanity: model_error (measured/predicted step ms) must exist
+    # and be a positive finite ratio.  On TPU it is meaningful (~1-3); the
+    # CPU smoke is host-scheduling-bound so the ceiling only catches a
+    # broken prediction (zero, negative, or absurd), not slow hosts.
+    "model_error_max": 1.0e5,
+    # a bench run that emitted nothing has no trajectory row to contribute
+    "tokens_per_sec_min": 1.0,
+}
+
+
 @dataclasses.dataclass(frozen=True)
 class ProgramSource:
     """One declared jit/shard_map site cluster.

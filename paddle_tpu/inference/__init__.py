@@ -196,7 +196,9 @@ _SERVING = {"LLMEngine": "engine", "Request": "engine",
             "MetricsRegistry": "metrics", "Counter": "metrics",
             "Gauge": "metrics", "Histogram": "metrics",
             "log_buckets": "metrics", "FleetMetrics": "metrics",
+            "RateWindow": "metrics", "RATE_WINDOWS": "metrics",
             "RequestTrace": "tracing",
+            "evaluate_engine_health": "health", "HEALTH_STATES": "health",
             "ObservabilityServer": "obs_server"}
 
 
@@ -213,5 +215,6 @@ __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "LLMEngine", "Request", "RequestOutput", "RequestMetrics",
            "PagedKVCache", "DraftProposer", "NgramProposer",
            "MetricsRegistry", "Counter", "Gauge", "Histogram",
-           "log_buckets", "FleetMetrics", "RequestTrace",
+           "log_buckets", "FleetMetrics", "RateWindow", "RATE_WINDOWS",
+           "RequestTrace", "evaluate_engine_health", "HEALTH_STATES",
            "ObservabilityServer"]

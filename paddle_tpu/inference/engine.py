@@ -109,6 +109,23 @@ and Orca's iteration-level scheduling (Yu et al., OSDI 2022), under the same
   device capture when available.  Instrumentation is host-only: zero new
   compiled programs, spans skipped entirely unless a trace is recording.
 
+- **Health & perf signals** (the router-grade signal plane over the
+  telemetry above) — sliding-window rates (`inference.metrics.RateWindow`,
+  sampled once per step) derive tokens/s, admits/s, preemptions/s,
+  timeouts/s and rejects/s over ~10s/1m/5m from the engine counters,
+  exposed as pull gauges, `stats()["rates"]` and the Prometheus exposition;
+  multi-window SLO burn rates over the deadline-attainment account fold
+  with pool pressure, admission saturation and steady-state recompile
+  anomalies into `health()` / the `engine_health` gauge
+  (ok/degraded/overloaded against `analysis.registry.SERVE_SLO`, served by
+  the obs server's ``/healthz`` with 200/503 semantics, fleet-merged
+  worst-of); and the static roofline prediction goes live — `warm_decode()`
+  traces `engine_step_cost(...).predicted_ms` once (abstract, zero extra
+  dispatches or executables), steady-state step times feed an EWMA
+  `measured_step_ms` gauge, and `roofline_drift` (measured/predicted) plus
+  a drift-band alert counter and a `steady_state_recompiles` anomaly
+  counter surface silent perf regressions while they happen.
+
 - **Oversubscribed admission** (vLLM preempt-then-swap-or-recompute, Kwon et
   al. §4.3, over the Sarathi chunked-prefill machinery) —
   `admission="optimistic"` admits on the PROMPT footprint only and grows a
@@ -158,13 +175,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.registry import SERVE_SLO
 from ..models import gpt as gpt_mod
 from ..profiler import profiler as _prof
 from .cache import PagedKVCache
 from .faults import FaultInjected, FaultPlan
+from .health import HEALTH_CODES, evaluate_engine_health
 from .metrics import MetricsRegistry
 from .spec import DraftProposer, NgramProposer
 from .tracing import RequestTrace
+
+# measured-step EWMA smoothing: ~the last 10 busy steps dominate, so the
+# drift gauge reacts inside a scrape interval without tracking single-step
+# scheduler noise
+_EWMA_ALPHA = 0.2
 
 
 @dataclasses.dataclass(eq=False)
@@ -735,6 +759,72 @@ class LLMEngine:
         m.gauge("kv_pool_bytes", self.kv_pool_bytes,
                 "at-rest bytes of the device KV page pool (all lanes)")
         self.cache.attach_metrics(m)
+        # ---- health & perf signal plane (all host-side) -------------------
+        # windowed rates: sliding-window views over the counters above,
+        # sampled once per step() — the router's freshness-weighted signal
+        # (a counter answers "since reset", a probe needs "lately")
+        self._admitted_requests = m.counter(
+            "admitted_requests",
+            "requests popped into a slot (recompute resumes included)")
+        self._rw_tokens = m.rate_window(
+            "tokens_per_sec", lambda: self._decode_tokens.value,
+            help="decode tokens emitted per second")
+        self._rw_admits = m.rate_window(
+            "admits_per_sec", lambda: self._admitted_requests.value,
+            help="requests admitted per second")
+        self._rw_preemptions = m.rate_window(
+            "preemptions_per_sec", lambda: self._preemptions.value,
+            help="running requests preempted per second")
+        self._rw_timeouts = m.rate_window(
+            "timeouts_per_sec", lambda: self._timeouts.value,
+            help="requests retired by deadline expiry per second")
+        self._rw_rejects = m.rate_window(
+            "rejects_per_sec", lambda: self._rejected_requests.value,
+            help="requests rejected at intake per second")
+        # the stats()["rates"] surface, captured once: registry-owned ring
+        # state, independent of the per-signal handles health() evaluates
+        self._rate_surface = (self._rw_tokens, self._rw_admits,
+                              self._rw_preemptions, self._rw_timeouts,
+                              self._rw_rejects)
+        # burn-rate inputs: windowed deltas of the SLO account (not exposed
+        # as per-window gauges themselves — the burn ratios below are the
+        # signal; agg="max" because a burn is a fraction-of-budget ratio)
+        self._rw_deadline_req = m.rate_window(
+            "deadline_requests_window",
+            lambda: self._deadline_requests.value, expose=False)
+        self._rw_deadline_met = m.rate_window(
+            "deadline_met_window",
+            lambda: self._deadline_met.value, expose=False)
+        for _lbl, _w in self._rw_deadline_req.windows:
+            if _lbl in (SERVE_SLO["burn_window_fast"],
+                        SERVE_SLO["burn_window_slow"]):
+                m.gauge(f"slo_burn_rate_{_lbl}",
+                        (lambda w=_w: self._burn_rate(w)),
+                        f"deadline-attainment burn over the trailing {_lbl} "
+                        f"(1.0 = consuming the error budget exactly as fast "
+                        f"as the SLO allows)", agg="max")
+        # live roofline drift: predicted_step_ms traced once at warmup
+        # (lazy — never from a scrape), measured EWMA fed by busy steps
+        self._predicted_ms: Optional[float] = None
+        self._measured_ewma_ms: Optional[float] = None
+        self._drift_violation = False
+        self._exec_baseline: Optional[int] = None
+        self._roofline_alerts = m.counter(
+            "roofline_drift_alerts",
+            "transitions of roofline_drift out of the declared band")
+        self._ss_recompiles = m.counter(
+            "steady_state_recompiles",
+            "decode-side executable-count growth observed after warm")
+        m.gauge("measured_step_ms",
+                lambda: self._measured_ewma_ms or 0.0,
+                "EWMA wall time of busy engine steps (harvest to harvest)",
+                agg="max")
+        m.gauge("roofline_drift", self._roofline_drift,
+                "measured_step_ms / predicted_step_ms (0 until both exist)",
+                agg="max")
+        m.gauge("engine_health", self._health_code,
+                "health state code: 0 ok, 1 degraded, 2 overloaded "
+                "(fleet merge folds worst-of, not sum)", agg="max")
         self._lifecycles: Dict[int, RequestMetrics] = {}
         # per-request tracing (always-on observability plane; request_tracing
         # =False strips both the timelines and the exemplar attachment — the
@@ -930,12 +1020,26 @@ class LLMEngine:
         - live per-request timelines (`RequestOutput.trace` /
           ``/requests/<rid>``) are request state, not counters: in-flight
           traces and already-retired outputs survive, so exemplar handles
-          attached AFTER the reset keep resolving."""
+          attached AFTER the reset keep resolving;
+        - the signal plane restarts with the counters it derives from: rate
+          windows clear their sample rings (`MetricsRegistry.reset`), the
+          measured-step EWMA and the steady-state recompile baseline
+          re-seed on the next busy step (warmup compiles stay excluded the
+          same way warmup counter traffic does).  The static
+          `predicted_step_ms` survives — it is a property of the engine's
+          shapes, not of any run."""
         self.metrics.reset()
         self.cache.prefix_evictions = 0
         getattr(self.proposer, "reset_stats", lambda: None)()
         self._step_idx = 0
         self._step_trace.clear()
+        self._measured_ewma_ms = None
+        self._drift_violation = False
+        self._exec_baseline = None
+        # seed every rate ring with (t_reset, 0): events between the reset
+        # and the first step-end sample stay countable, and a young window
+        # reads exactly events-since-reset / elapsed-since-reset
+        self.metrics.sample_rates()
 
     # ---- request intake ---------------------------------------------------
     def add_request(self, prompt, max_new_tokens: int = 16,
@@ -1004,6 +1108,9 @@ class LLMEngine:
             # _admit's wait-for-pages path
             self._rejected_requests.inc()
             self._finish_output(req, [], "rejected", 0, None)
+            # anchor the reject in the rate rings at its true time (intake
+            # runs outside step(), whose sampling would otherwise miss it)
+            self.metrics.sample_rates(force=True)
             return rid
         if self.optimistic and self.preempt == "swap" and \
                 self.swap_pool_pages > 0 and need > self.swap_pool_pages:
@@ -1020,6 +1127,7 @@ class LLMEngine:
             self._intake_swap_rejects.inc()
             self._rejected_requests.inc()
             self._finish_output(req, [], "rejected", 0, None)
+            self.metrics.sample_rates(force=True)
             return rid
         if deadline is not None:
             self._has_deadlines = True
@@ -1270,6 +1378,17 @@ class LLMEngine:
                 self._drain_swap_d2h()
         dur = self._now() - t0
         self._h_step.observe(dur)
+        if self._step_dispatches:
+            # busy steps only: an idle/admission-only step measures the
+            # scheduler, not the serving step the roofline predicts
+            self._note_steady_state(dur)
+        # one rate-window sample per step (throttled), FORCED on eventful
+        # steps (retirements or preemptions) so the last event before the
+        # engine goes idle is anchored at its true time — that is what
+        # makes idle rates read exactly 0.0 once the window passes the
+        # burst, instead of decaying against a stale reference
+        self.metrics.sample_rates(
+            force=bool(finished) or self._step_preempted > 0)
         self._step_idx += 1
         mgr = self.cache
         self._step_trace.append({
@@ -1812,6 +1931,7 @@ class LLMEngine:
                 lc.queue_s = lc.t_admit - lc.t_enqueue
                 self._h_queue.observe(lc.queue_s, exemplar=self._exemplar(rid))
                 lc.cached_tokens = matched
+            self._admitted_requests.inc()
             self._tev(rid, "admit", slot=slot, prefix_hit_tokens=int(matched),
                       cow=cow is not None, resume=rec is not None)
             if rec is not None:
@@ -2134,6 +2254,10 @@ class LLMEngine:
                 self._h2d(tbl), self._h2d(np.zeros((B,), np.int32)),
                 self._key, self._h2d(np.zeros((B,), bool)))
         self._decode_used = True
+        # warmup is also where the live roofline arms: one abstract trace of
+        # the decode-side program (cached; zero dispatches, zero programs)
+        # so the drift gauge reads real from the first steady-state step
+        _ = self.predicted_step_ms
 
     def warm_swap(self) -> None:
         """Compile the preemption swap gather/scatter against null-page ids
@@ -2187,6 +2311,80 @@ class LLMEngine:
         same token geometry in ~2-4x fewer bytes)."""
         return int(sum(int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
                        for a in self._pool.values()))
+
+    # ---- health & perf signal plane ---------------------------------------
+    @property
+    def predicted_step_ms(self) -> float:
+        """Static roofline prediction for the decode-side program at this
+        engine's shapes (`analysis.cost_model.engine_step_cost` over the
+        nameplate `device_spec()`), traced abstractly ONCE and cached — no
+        dispatch, no new executable, program counts untouched.
+        `warm_decode()` takes the trace during warmup so the drift gauge is
+        live from the first steady-state step; reading the property earlier
+        pays the one-off trace right here."""
+        if self._predicted_ms is None:
+            from ..analysis.cost_model import device_spec, engine_step_cost
+            self._predicted_ms = engine_step_cost(self).predicted_ms(
+                device_spec(), mp=self.mp)
+        return self._predicted_ms
+
+    def _roofline_drift(self) -> float:
+        """measured_step_ms EWMA / predicted roofline ms — the live drift
+        gauge.  0.0 until BOTH exist (never triggers the trace itself: a
+        metrics scrape must stay a pure read)."""
+        if not self._predicted_ms or not self._measured_ewma_ms:
+            return 0.0
+        return self._measured_ewma_ms / self._predicted_ms
+
+    def _note_steady_state(self, dur_s: float) -> None:
+        """Per-busy-step bookkeeping of the live perf signals: the
+        measured-step EWMA, the drift-band alert counter (TRANSITIONS into
+        violation, not steps spent there) and the steady-state recompile
+        anomaly (decode-side executable count growing after the first busy
+        step fixed the baseline — a fixed-shape engine must never do that)."""
+        ms = dur_s * 1e3
+        self._measured_ewma_ms = ms if self._measured_ewma_ms is None else \
+            _EWMA_ALPHA * ms + (1.0 - _EWMA_ALPHA) * self._measured_ewma_ms
+        try:
+            n = self._decode_fn._cache_size()
+        except AttributeError:
+            n = 1 if self._decode_used else 0
+        if self._exec_baseline is None:
+            self._exec_baseline = n
+        elif n > self._exec_baseline:
+            self._ss_recompiles.inc(n - self._exec_baseline)
+            self._exec_baseline = n
+        drift = self._roofline_drift()
+        lo, hi = SERVE_SLO["roofline_drift_band"]
+        bad = bool(drift) and not (lo <= drift <= hi)
+        if bad and not self._drift_violation:
+            self._roofline_alerts.inc()
+        self._drift_violation = bad
+
+    def _burn_rate(self, window_s: float) -> float:
+        """Deadline-attainment burn over one window (`health.burn_rate`
+        semantics): in-window miss fraction over the declared error budget."""
+        from .health import burn_rate
+        return burn_rate(self._rw_deadline_req, self._rw_deadline_met,
+                         window_s, SERVE_SLO["deadline_attainment_target"])
+
+    def health(self) -> Dict[str, object]:
+        """The engine's health report — state (ok/degraded/overloaded),
+        numeric code, per-signal detail and reasons — evaluated against
+        `analysis.registry.SERVE_SLO` from host state only (see
+        `inference.health`).  The obs server's ``/healthz`` serves it with
+        200/503 semantics; `stats()["health"]` carries the compact pair."""
+        return evaluate_engine_health(self)
+
+    def _health_code(self) -> float:
+        """The `engine_health` gauge read: 0 ok / 1 degraded / 2 overloaded.
+        A health evaluation that cannot run at all reads as the worst state
+        — a wedged engine must never scrape as healthy — and the exception
+        is preserved for ``/healthz``, which re-evaluates and reports it."""
+        try:
+            return float(self.health()["code"])
+        except Exception:
+            return float(max(HEALTH_CODES.values()))
 
     def run(self) -> Dict[int, RequestOutput]:
         """Drain the queue: step until every request completes.  Returns
@@ -2259,6 +2457,17 @@ class LLMEngine:
         cached = self._prefix_cached_tokens.value
         computed = self._prefilled_tokens.value
         spec_events = self._spec_events.value
+        try:
+            health = self.health()
+        except Exception as e:
+            # stats() feeds the crash postmortem (debug_bundle) and /stats:
+            # a signal plane wrecked by the very crash being postmortemed
+            # must degrade to an "error" health entry, not take the whole
+            # surface down (same contract as /healthz and the gauge)
+            health = {"state": "error", "code": max(HEALTH_CODES.values()),
+                      "reasons": [f"health evaluation failed: "
+                                  f"{type(e).__name__}: {e}"],
+                      "burn_rates": {}}
         # fused mode: _decode_fn IS the one fused program (decode-side count
         # 1); the standalone verify/chunk programs are never built (None)
         return {
@@ -2349,6 +2558,29 @@ class LLMEngine:
                 "goodput_tokens_by_priority":
                     {p: c.value
                      for p, c in sorted(self._goodput_prio.items())},
+            },
+            # windowed rates (health & signals PR): sliding-window views of
+            # the counters above — tokens/s etc. over ~10s/1m/5m, the
+            # router's freshness-weighted signal (also pull gauges, e.g.
+            # `tokens_per_sec_10s`, in the exposition)
+            "rates": {rw.name: rw.rates() for rw in self._rate_surface},
+            # compact health pair (full per-signal report via health());
+            # state folds SLO burn + pressure + admission saturation +
+            # recompile anomalies against analysis.registry.SERVE_SLO
+            "health": {
+                "state": health["state"],
+                "code": health["code"],
+                "reasons": health["reasons"],
+                "burn_rates": health["burn_rates"],
+            },
+            # live roofline: the PR-8 static prediction next to the
+            # steady-state EWMA it is now compared against every step
+            "roofline": {
+                "predicted_step_ms": self._predicted_ms,    # None until armed
+                "measured_step_ms": self._measured_ewma_ms,
+                "drift": self._roofline_drift() or None,
+                "drift_alerts": self._roofline_alerts.value,
+                "steady_state_recompiles": self._ss_recompiles.value,
             },
             # latency distributions (engine-side histograms; seconds) — the
             # serving SLO surface: benches report p50/p99 straight from here

@@ -22,6 +22,13 @@ deterministically:
 The plan is mutable state (consumed injections are spent); build a fresh one
 per engine.  Production engines run with the inert default plan — every hook
 is a cheap attribute read returning falsy.
+
+The health plane rides the same hooks: forced pool pressure drives the
+preemption rate that flips `/healthz` to 503 (and back to 200 once the rate
+window ages out), and clock skew drives deadline timeouts — the SLO
+burn-rate and admission-saturation signals — so every
+ok/degraded/overloaded transition is testable deterministically under the
+fake clock (see tests/test_observability.py).
 """
 from __future__ import annotations
 

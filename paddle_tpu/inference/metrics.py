@@ -34,6 +34,20 @@ Export surfaces:
   for a scrape endpoint (`inference.obs_server` serves it on ``GET
   /metrics``).  `tools/check_metrics.py` parses this output in CI.
 
+One signal-plane extension (the health plane's freshness-weighted input):
+- **`RateWindow`** — a ring of ``(t, counter_value)`` samples on the
+  registry clock that derives *sliding-window rates* from the monotonic
+  counters above (tokens/s, admits/s, preemptions/s over ~10s/1m/5m).
+  Counters alone answer "how much since reset"; a router or health probe
+  needs "how much *lately*" — `registry.rate_window()` registers one and
+  exposes each window as a pull gauge, `sample_rates()` is the engine's
+  once-per-step recording hook, and the math is exact under the injectable
+  clock (golden-value testable): the live counter value is the window's
+  right edge, the newest ring sample at or before ``now - window`` its
+  left.  `reset()` clears the rings with the counters (the warmup-exclusion
+  contract), and a counter observed DECREASING (reset underneath the ring)
+  restarts the window instead of reporting a negative rate.
+
 Two fleet-facing extensions (the dp-group router's input):
 - **Exemplars** — `Histogram.observe(v, exemplar={...labels...})` remembers,
   per bucket, the labels of the latest observation that landed there
@@ -56,8 +70,8 @@ import math
 import re
 import time
 from bisect import bisect_left
-from collections import OrderedDict
-from typing import Callable, Dict, List, Optional, Sequence
+from collections import OrderedDict, deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 
 def log_buckets(lo: float, hi: float, per_decade: int = 4) -> List[float]:
@@ -249,6 +263,133 @@ class Histogram:
         }
 
 
+# the serving signal plane's standard windows: fast enough for a health
+# probe (~10s), the multi-window burn-rate pair (1m/5m) for SLO alerting
+RATE_WINDOWS: Tuple[Tuple[str, float], ...] = \
+    (("10s", 10.0), ("1m", 60.0), ("5m", 300.0))
+
+
+class RateWindow:
+    """Sliding-window rates over a monotonic counter: a ring of
+    ``(t, value)`` samples on the shared registry clock.
+
+    `sample()` records the counter's current value (throttled to
+    `min_interval_s` so a kHz step loop cannot grow the ring past
+    ``max_window / min_interval`` entries; samples older than the largest
+    window are pruned, always keeping the newest one at or beyond the
+    horizon as the reference).  `rate(window_s)` reads LIVE state — the
+    counter's value now against the newest sample at or before
+    ``now - window_s`` (or the oldest sample while the ring is younger than
+    the window) — so an idle engine's rates decay to exactly 0.0 without
+    further sampling, and the math is deterministic under a fake clock:
+
+    - empty ring -> 0.0 (no reference, no rate);
+    - single sample at ``now`` -> 0.0 (zero elapsed);
+    - counter DECREASED vs the reference (reset underneath the ring) ->
+      ring restarts, 0.0 — never a negative rate.
+
+    `delta(window_s)` is the raw in-window count increment — what burn-rate
+    ratios divide (two windows sampled at the same instants share reference
+    timestamps, so the elapsed time cancels exactly)."""
+
+    __slots__ = ("name", "fn", "windows", "min_interval_s", "_clock",
+                 "_samples", "_max_window")
+
+    def __init__(self, name: str, fn: Callable[[], float],
+                 clock: Callable[[], float],
+                 windows: Sequence[Tuple[str, float]] = RATE_WINDOWS,
+                 min_interval_s: float = 0.25):
+        self.name = name
+        self.fn = fn
+        self._clock = clock
+        self.windows: Tuple[Tuple[str, float], ...] = \
+            tuple((str(lbl), float(w)) for lbl, w in windows)
+        if not self.windows or any(w <= 0.0 for _, w in self.windows):
+            raise ValueError(f"rate window {name!r} needs positive window "
+                             f"lengths, got {windows}")
+        self.min_interval_s = float(min_interval_s)
+        self._max_window = max(w for _, w in self.windows)
+        self._samples: deque = deque()      # (t, value), time-ordered
+
+    def sample(self, force: bool = False) -> None:
+        """Record ``(now, fn())`` — the engine calls this once per step.
+        `force=True` overrides the interval throttle: the engine forces a
+        sample on EVENTFUL steps (finishes, preemptions, intake rejects) so
+        a burst right before the engine goes idle is anchored at its true
+        time — otherwise those unanchored events would decay hyperbolically
+        against an old reference instead of dropping to exactly 0.0 once
+        the window passes them.  A forced sample inside the throttle
+        interval SLIDES the newest ring entry forward instead of appending
+        (when that entry is itself within the interval of its predecessor),
+        so sustained eventful load keeps the latest anchor exact while the
+        ring stays bounded at ~max_window/min_interval entries."""
+        now = self._clock()
+        v = float(self.fn())
+        if self._samples:
+            t_last, v_last = self._samples[-1]
+            if v < v_last:          # counter reset underneath the ring
+                self._samples.clear()
+            elif now - t_last < self.min_interval_s:
+                if not force:
+                    return
+                if len(self._samples) >= 2 and \
+                        t_last - self._samples[-2][0] < self.min_interval_s:
+                    self._samples[-1] = (now, v)    # slide the anchor
+                    return
+        self._samples.append((now, v))
+        horizon = now - self._max_window
+        # keep the NEWEST sample at or beyond the horizon: it is the exact
+        # reference for the largest window until a closer one ages past
+        while len(self._samples) >= 2 and self._samples[1][0] <= horizon:
+            self._samples.popleft()
+
+    def _reference(self, now: float, window_s: float) -> Optional[tuple]:
+        cut = now - window_s
+        for t, v in reversed(self._samples):
+            if t <= cut:
+                return (t, v)
+        return self._samples[0] if self._samples else None
+
+    def _live(self) -> Optional[float]:
+        """The counter's current value, with reset detection against the
+        NEWEST ring sample (the ring maximum — the source is monotonic):
+        a value below it means the counter was reset underneath the ring,
+        so the window restarts instead of reporting a phantom rate."""
+        v_now = float(self.fn())
+        if self._samples and v_now < self._samples[-1][1]:
+            self._samples.clear()
+            return None
+        return v_now
+
+    def delta(self, window_s: float) -> float:
+        """Counter increment inside the window (>= 0.0; 0.0 on an empty
+        ring or across a counter reset)."""
+        v_now = self._live()
+        ref = self._reference(self._clock(), window_s)
+        if v_now is None or ref is None:
+            return 0.0
+        return max(0.0, v_now - ref[1])
+
+    def rate(self, window_s: float) -> float:
+        """Events/second over the window — see the class docstring for the
+        exact reference-sample semantics."""
+        now = self._clock()
+        v_now = self._live()
+        ref = self._reference(now, window_s)
+        if v_now is None or ref is None:
+            return 0.0
+        t_ref, v_ref = ref
+        dt = now - t_ref
+        return (v_now - v_ref) / dt if dt > 0.0 else 0.0
+
+    def rates(self) -> Dict[str, float]:
+        """{window label: rate} over every configured window."""
+        return {lbl: self.rate(w) for lbl, w in self.windows}
+
+    def reset(self) -> None:
+        self._samples.clear()
+
+
 def _sanitize(name: str) -> str:
     """Prometheus metric-name charset: [a-zA-Z_:][a-zA-Z0-9_:]*."""
     name = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
@@ -314,6 +455,7 @@ class MetricsRegistry:
         self.namespace = namespace
         self._clock = clock
         self._metrics: "OrderedDict[str, object]" = OrderedDict()
+        self._rate_windows: "OrderedDict[str, RateWindow]" = OrderedDict()
 
     def now(self) -> float:
         """The registry clock — every lifecycle stamp the engine takes goes
@@ -345,15 +487,49 @@ class MetricsRegistry:
         return self._register(name, Histogram,
                               lambda: Histogram(name, buckets, help))
 
+    def rate_window(self, name: str, fn: Callable[[], float],
+                    windows: Sequence[Tuple[str, float]] = RATE_WINDOWS,
+                    help: str = "", min_interval_s: float = 0.25,
+                    agg: str = "sum", expose: bool = True) -> RateWindow:
+        """A `RateWindow` over `fn` (a live counter read) on the registry
+        clock, idempotent per name.  With `expose=True` each window also
+        registers a pull gauge ``<name>_<label>`` (e.g. ``tokens_per_sec_10s``)
+        so the rates ride every existing surface — snapshot, exposition,
+        fleet merge — for free; `agg` is those gauges' fleet fold (rates are
+        levels: fleet tokens/s SUM across replicas).  `sample_rates()`
+        records one sample on every window; `reset()` clears the rings."""
+        rw = self._rate_windows.get(name)
+        if rw is not None:
+            return rw
+        rw = RateWindow(name, fn, self.now, windows, min_interval_s)
+        self._rate_windows[name] = rw
+        if expose:
+            for lbl, w in rw.windows:
+                self.gauge(f"{name}_{lbl}", (lambda w=w: rw.rate(w)),
+                           help=f"{help or name} over the trailing {lbl}",
+                           agg=agg)
+        return rw
+
+    def sample_rates(self, force: bool = False) -> None:
+        """Record one ``(now, value)`` sample on every rate window — the
+        engine's once-per-step hook (each window throttles itself unless
+        `force`, which eventful steps use to anchor their events exactly)."""
+        for rw in self._rate_windows.values():
+            rw.sample(force)
+
     def get(self, name: str):
         return self._metrics.get(name)
 
     def reset(self) -> None:
         """Zero counters and histograms (set-gauges too; callback gauges read
-        live state and have nothing to reset) — the engine's
-        `reset_counters()` warmup-exclusion hook."""
+        live state and have nothing to reset) and clear every rate window's
+        sample ring (the counters underneath restart at zero, so a surviving
+        ring would read negative deltas) — the engine's `reset_counters()`
+        warmup-exclusion hook."""
         for m in list(self._metrics.values()):
             m.reset()
+        for rw in self._rate_windows.values():
+            rw.reset()
 
     def snapshot(self) -> Dict[str, Dict[str, object]]:
         """Plain-JSON view: counters/gauges as scalars, histograms as

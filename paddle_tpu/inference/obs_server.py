@@ -27,7 +27,16 @@ This module is that front door for `inference.engine.LLMEngine` (and, via
 - ``GET /debug`` — the postmortem bundle (`LLMEngine.debug_bundle()`:
   per-request states + timelines, step-trace ring, pool levels, stats,
   metrics snapshot) as JSON (fleet: ``{label: bundle}``).
-- ``GET /healthz`` — liveness probe, ``{"ok": true}``.
+- ``GET /healthz`` — the engine's REAL health evaluation
+  (`LLMEngine.health()` against `analysis.registry.SERVE_SLO`: multi-window
+  SLO burn rates, pool pressure, admission saturation, preemption churn,
+  steady-state recompile anomalies), not a hardcoded liveness stub.
+  ``ok``/``degraded`` answer 200 with the state and per-signal reasons in
+  the body (degraded still serves traffic — a router should deprioritize,
+  not eject); ``overloaded`` — or a health evaluation that cannot run at
+  all, i.e. an engine wedged mid-crash — answers 503 so a probe takes the
+  replica out of rotation.  Fleet mode reports per-engine detail plus a
+  worst-of rollup (a fleet is as healthy as its sickest member).
 
 Serving runs on a **daemon thread** (`ThreadingHTTPServer`) bound to an
 ephemeral port by default (`port=0`; read `.port` after `start()`), so an
@@ -58,6 +67,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs
 
+from .health import HEALTH_CODES
 from .metrics import FleetMetrics
 
 # exemplars are OpenMetrics-only syntax: a stock Prometheus text-format
@@ -69,6 +79,25 @@ from .metrics import FleetMetrics
 _METRICS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 _OPENMETRICS_CONTENT_TYPE = \
     "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
+# the one route table: dispatch documentation AND the 404 body read it, so
+# the advertised set cannot drift from what is actually served
+ROUTES = ("/metrics", "/stats", "/requests/<rid>", "/debug", "/healthz")
+
+# worst-of ordering for the fleet /healthz rollup, derived from the ONE
+# declared state ordering (health.HEALTH_CODES) so a new health state cannot
+# desynchronize the rollup; "error" (an evaluation that raised — the engine
+# is wedged mid-crash) outranks every real state, and anything unrecognized
+# ranks worst too — and therefore serves 503, never a blind 200
+_ERROR_CODE = max(HEALTH_CODES.values()) + 1
+_HEALTH_SEVERITY = {**HEALTH_CODES, "error": _ERROR_CODE}
+
+
+def _health_status(state: str) -> int:
+    """HTTP status for a health state: 200 up to degraded, 503 from
+    overloaded up (error and unknown states included)."""
+    return 503 if _HEALTH_SEVERITY.get(state, _ERROR_CODE) >= \
+        HEALTH_CODES["overloaded"] else 200
 
 
 class ObservabilityServer:
@@ -159,6 +188,35 @@ class ObservabilityServer:
             return {label: e.debug_bundle() for label, e in self._engines()}
         return self.engine.debug_bundle()
 
+    def render_health(self):
+        """``(status_code, payload)`` for ``/healthz``: the engine's health
+        evaluation, no longer a hardcoded ``{"ok": true}``.  ok/degraded are
+        200 (degraded still serves; the state and reasons ride the body),
+        overloaded is 503; an evaluation that RAISES — the exact
+        wedged-mid-crash case the old stub answered 200 to — reports
+        ``state="error"`` with the exception text, also 503.  Fleet mode:
+        per-engine reports plus the worst-of rollup."""
+        def one(e):
+            try:
+                h = e.health()
+                return {"state": h["state"], "code": h["code"],
+                        "reasons": h["reasons"], "signals": h["signals"]}
+            except Exception as err:
+                # same shape as a real report (probes read code/signals)
+                return {"state": "error", "code": _ERROR_CODE,
+                        "reasons": [f"health evaluation failed: "
+                                    f"{type(err).__name__}: {err}"],
+                        "signals": {}}
+
+        if self.fleet is not None:
+            reports = {label: one(e) for label, e in self._engines()}
+            worst = max((r["state"] for r in reports.values()),
+                        key=lambda s: _HEALTH_SEVERITY.get(s, _ERROR_CODE),
+                        default="ok")
+            return _health_status(worst), {"state": worst, "engines": reports}
+        rep = one(self.engine)
+        return _health_status(rep["state"]), rep
+
     def render_request(self, rid: int, engine: Optional[str] = None):
         """``(status, payload)`` for ``/requests/<rid>``: ``("ok", tree)``,
         ``("not_found", None)``, or — fleet mode only — ``("ambiguous",
@@ -214,7 +272,11 @@ def _make_handler(srv: ObservabilityServer):
                 elif path == "/debug":
                     self._send_json(srv.render_debug())
                 elif path == "/healthz":
-                    self._send_json({"ok": True})
+                    # routed through the real health evaluation (render_
+                    # health never raises: an evaluation failure IS a 503
+                    # payload, not a generic 500 — and never a blind 200)
+                    code, payload = srv.render_health()
+                    self._send_json(payload, code)
                 elif path.startswith("/requests/"):
                     tail = path[len("/requests/"):]
                     try:
@@ -242,9 +304,7 @@ def _make_handler(srv: ObservabilityServer):
                         self._send_json(payload)
                 else:
                     self._send_json({"error": f"no route {path!r}",
-                                     "routes": ["/metrics", "/stats",
-                                                "/requests/<rid>", "/debug",
-                                                "/healthz"]}, 404)
+                                     "routes": list(ROUTES)}, 404)
             except (BrokenPipeError, ConnectionResetError):
                 # client hung up mid-write (scrape timeout, curl Ctrl-C):
                 # nothing to send a response TO — just drop the connection

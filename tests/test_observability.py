@@ -425,6 +425,10 @@ NEW_STATS_KEYS = frozenset({
     # added by the observability-plane PR (SLO block: deadline attainment +
     # per-priority-class goodput — the router's SLO layer input)
     "slo",
+}) | frozenset({
+    # added by the health & signals PR: windowed rates, the folded health
+    # state, and the live roofline account
+    "rates", "health", "roofline",
 })
 
 
@@ -881,7 +885,14 @@ def test_obs_server_endpoint_smoke(tiny):
         assert _http_get(srv.url + "/requests/nope")[0] == 400
         assert _http_get(srv.url + "/nosuch")[0] == 404
         code, text = _http_get(srv.url + "/healthz")
-        assert code == 200 and json.loads(text) == {"ok": True}
+        health = json.loads(text)
+        assert code == 200 and health["state"] in ("ok", "degraded")
+        assert "signals" in health and "reasons" in health  # not the old stub
+        # the 404 route list advertises exactly the served routes
+        code, text = _http_get(srv.url + "/nosuch")
+        assert code == 404
+        assert set(json.loads(text)["routes"]) == {
+            "/metrics", "/stats", "/requests/<rid>", "/debug", "/healthz"}
         code, text = _http_get(srv.url + "/debug")
         assert code == 200
         assert REQUIRED_DEBUG_BUNDLE_KEYS <= set(json.loads(text))
@@ -983,6 +994,434 @@ def test_debug_bundle_valid_after_forced_fault_crash(tiny, tmp_path):
     assert isinstance(bundle["pool"]["pages_in_use"], int)
     assert "slo" in bundle["stats"]
     assert bundle["metrics"]["counters"]["preemptions"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# health & perf signal plane (ISSUE 13): windowed rates, burn-rate health,
+# live roofline drift, serving-bench trajectory
+# ---------------------------------------------------------------------------
+
+def test_rate_window_golden_values():
+    """RateWindow math is exact under an injectable clock: empty ring,
+    single sample, live right-edge reads, young-ring oldest-sample
+    reference, in-window reference selection, and idle decay to 0.0."""
+    from paddle_tpu.inference.metrics import RateWindow
+    t = [0.0]
+    v = [0]
+    rw = RateWindow("r", lambda: v[0], lambda: t[0],
+                    (("10s", 10.0), ("1m", 60.0)), min_interval_s=0.0)
+    assert rw.rate(10.0) == 0.0                 # empty ring: no reference
+    rw.sample()                                 # (0, 0)
+    assert rw.rate(10.0) == 0.0                 # single sample, zero elapsed
+    t[0], v[0] = 5.0, 50
+    # live read against the ring: (50 - 0) / (5 - 0) — no sample needed
+    assert rw.rate(10.0) == pytest.approx(10.0)
+    rw.sample()                                 # (5, 50)
+    t[0], v[0] = 8.0, 80
+    # ring younger than the window: the OLDEST sample is the reference
+    assert rw.rate(10.0) == pytest.approx(10.0)     # 80 / 8
+    assert rw.delta(10.0) == pytest.approx(80.0)
+    rw.sample()                                 # (8, 80)
+    t[0] = 16.0
+    # newest sample at or before now-10 = (5, 50): (80-50)/(16-5)
+    assert rw.rate(10.0) == pytest.approx(30.0 / 11.0)
+    assert rw.delta(10.0) == pytest.approx(30.0)
+    # the 1m window still spans everything: 80 events over 16 s
+    assert rw.rate(60.0) == pytest.approx(5.0)
+    # idle decay: the counter stopped, so every window reads exactly 0.0
+    # with no further samples
+    t[0] = 100.0
+    assert rw.rate(10.0) == 0.0
+    assert rw.rate(60.0) == 0.0
+    assert rw.rates() == {"10s": 0.0, "1m": 0.0}
+    with pytest.raises(ValueError):
+        RateWindow("bad", lambda: 0, lambda: 0.0, (("w", -1.0),))
+
+
+def test_rate_window_reset_and_pruning():
+    """A counter observed DECREASING (reset underneath the ring) restarts
+    the window instead of reporting a negative rate; pruning keeps exactly
+    one reference sample beyond the horizon; registry reset clears rings."""
+    from paddle_tpu.inference.metrics import MetricsRegistry, RateWindow
+    t = [0.0]
+    v = [0]
+    rw = RateWindow("r", lambda: v[0], lambda: t[0], (("10s", 10.0),),
+                    min_interval_s=0.0)
+    rw.sample()
+    t[0], v[0] = 5.0, 50
+    rw.sample()
+    v[0] = 3                                    # counter reset mid-window
+    assert rw.rate(10.0) == 0.0                 # never negative
+    assert not rw._samples                      # ring restarted
+    rw.sample()                                 # (5, 3): fresh baseline
+    t[0], v[0] = 7.0, 13
+    assert rw.rate(10.0) == pytest.approx(5.0)  # (13-3)/2 post-reset only
+    # sample() detects the reset too (no rate() call needed)
+    v[0] = 0
+    rw.sample()
+    assert list(rw._samples) == [(7.0, 0.0)]
+    # pruning: samples past the horizon drop, keeping the newest one at or
+    # beyond it as the exact reference for the largest window
+    for i in range(1, 8):
+        t[0], v[0] = 7.0 + 2.0 * i, 10 * i
+        rw.sample()
+    assert all(tt > t[0] - 10.0 for tt, _ in list(rw._samples)[1:])
+    assert rw._samples[0][0] <= t[0] - 10.0     # the kept reference
+    # forced samples anchor eventful bursts WITHOUT growing the ring:
+    # inside the throttle interval they slide the newest entry forward
+    # (when it is itself within the interval of its predecessor)
+    rw3 = RateWindow("f", lambda: v[0], lambda: t[0], (("10s", 10.0),),
+                     min_interval_s=1.0)
+    t[0], v[0] = 100.0, 0
+    rw3.sample()
+    t[0], v[0] = 100.2, 2
+    rw3.sample(force=True)              # appended (lone predecessor)
+    t[0], v[0] = 100.4, 4
+    rw3.sample(force=True)              # slides the 100.2 anchor
+    t[0], v[0] = 100.6, 6
+    rw3.sample(force=True)              # slides again: ring stays at 2
+    assert list(rw3._samples) == [(100.0, 0.0), (100.6, 6.0)]
+    t[0] = 100.8
+    rw3.sample()                        # unforced inside the interval: no-op
+    assert list(rw3._samples) == [(100.0, 0.0), (100.6, 6.0)]
+    # the anchor is exact: once the window passes the burst, rate reads 0
+    t[0] = 200.0
+    assert rw3.rate(10.0) == 0.0
+    # registry wiring: per-window pull gauges + reset clears the ring
+    reg = MetricsRegistry(clock=lambda: t[0])
+    c = reg.counter("events")
+    rw2 = reg.rate_window("events_per_sec", lambda: c.value,
+                          (("10s", 10.0),), min_interval_s=0.0)
+    assert reg.rate_window("events_per_sec", lambda: -1) is rw2  # idempotent
+    t0 = t[0]
+    reg.sample_rates()
+    c.inc(40)
+    t[0] = t0 + 4.0
+    assert reg.snapshot()["gauges"]["events_per_sec_10s"] == \
+        pytest.approx(10.0)
+    assert "events_per_sec_10s" in reg.to_prometheus()
+    reg.reset()
+    assert not rw2._samples and c.value == 0
+
+
+def test_engine_rates_exact_under_fake_clock(tiny):
+    """stats()['rates'] golden values through the engine: the reset-time
+    seed sample makes a young window read exactly events-since-reset over
+    elapsed-since-reset; idle decay and the reset_counters contract hold."""
+    cfg, params = tiny
+    clk = FakeClock(50.0)
+    eng = LLMEngine(params, cfg, num_slots=2, page_size=8, max_model_len=64,
+                    clock=clk, double_buffer=False)
+    eng.add_request(np.arange(5, dtype=np.int32), max_new_tokens=4)
+    while eng.has_work:
+        clk.t += 1.0
+        eng.step()
+    st = eng.stats()
+    elapsed = clk.t - 50.0
+    tokens = st["decode_tokens"]            # decode-emitted (first token is
+    assert tokens >= 3                      # prefill's, not counted here)
+    for w in ("10s", "1m", "5m"):
+        # span < every window: the seed sample at t=50 is the reference
+        assert st["rates"]["tokens_per_sec"][w] == \
+            pytest.approx(tokens / elapsed)
+    assert st["rates"]["admits_per_sec"]["5m"] == pytest.approx(1 / elapsed)
+    assert st["rates"]["preemptions_per_sec"]["10s"] == 0.0
+    # the same numbers ride the exposition as pull gauges
+    snap = eng.metrics.snapshot()["gauges"]
+    assert snap["tokens_per_sec_10s"] == pytest.approx(tokens / elapsed)
+    # idle decay: the engine stops, rates fall to exactly 0.0 untouched
+    clk.t += 400.0
+    assert eng.stats()["rates"]["tokens_per_sec"]["5m"] == 0.0
+    # reset mid-life: rings restart with the counters (the PR-12 reset
+    # contract extended) — post-reset rates count post-reset events only
+    eng.reset_counters()
+    t_reset = clk.t
+    eng.add_request(np.arange(3, dtype=np.int32), max_new_tokens=2)
+    while eng.has_work:
+        clk.t += 2.0
+        eng.step()
+    st = eng.stats()
+    assert st["decode_tokens"] >= 1
+    assert st["rates"]["tokens_per_sec"]["5m"] == \
+        pytest.approx(st["decode_tokens"] / (clk.t - t_reset))
+
+
+def test_slo_burn_rates_and_health_under_clock_skew(tiny):
+    """Burn-rate edges under the fake clock: no deadline traffic burns 0
+    (ok); on-time finishes burn 0; FaultPlan clock skew forcing timeouts
+    sends the fast burn over the overload threshold with the slow window
+    confirming — engine_health goes overloaded with slo_burn named in the
+    reasons — and the window aging out recovers it to ok."""
+    cfg, params = tiny
+    clk = FakeClock(10.0)
+    eng = LLMEngine(params, cfg, num_slots=2, page_size=8, num_pages=17,
+                    max_model_len=64, clock=clk, double_buffer=False)
+    h = eng.health()
+    assert h["state"] == "ok" and h["burn_rates"]["1m"] == 0.0
+    ok = eng.add_request(np.arange(5, dtype=np.int32), max_new_tokens=3,
+                         deadline_s=1000.0)
+    clk.t = 11.0
+    eng.run()
+    assert eng._outputs[ok].finish_reason in ("stop", "length")
+    h = eng.health()
+    assert h["state"] == "ok"
+    assert h["burn_rates"]["1m"] == 0.0         # met on time: nothing burns
+    assert eng.stats()["health"]["state"] == "ok"
+    # injected clock skew: deadline evaluation sees now + 10000 s, so the
+    # request times out on its first step — a 100% in-window miss rate over
+    # the 1% error budget = burn 100 on both windows
+    eng2 = LLMEngine(params, cfg, num_slots=2, page_size=8, num_pages=17,
+                     max_model_len=64, clock=clk, double_buffer=False,
+                     fault_plan=FaultPlan(skew_s=10_000.0))
+    late = eng2.add_request(np.arange(5, dtype=np.int32), max_new_tokens=3,
+                            deadline_s=5.0)
+    clk.t += 1.0
+    eng2.step()
+    assert eng2._outputs[late].finish_reason == "timeout"
+    clk.t += 1.0
+    eng2.step()                                 # sample the rings post-miss
+    h = eng2.health()
+    assert h["burn_rates"]["1m"] == pytest.approx(100.0)
+    assert h["burn_rates"]["5m"] == pytest.approx(100.0)
+    assert h["state"] == "overloaded"
+    assert h["signals"]["slo_burn"]["state"] == "overloaded"
+    assert any(r.startswith("slo_burn") for r in h["reasons"])
+    assert eng2._health_code() == 2.0
+    # timeouts are also admission saturation: the signal fires on its own
+    assert h["signals"]["admission"]["state"] != "ok"
+    # recovery: the miss ages past every window — burn and rates decay to
+    # exactly 0 and health folds back to ok without any reset
+    clk.t += 400.0
+    h = eng2.health()
+    assert h["burn_rates"] == {"10s": 0.0, "1m": 0.0, "5m": 0.0}
+    assert h["state"] == "ok" and h["reasons"] == []
+
+
+def test_healthz_503_roundtrip_forced_pressure(tiny):
+    """Acceptance bar: over a real socket, FaultPlan-forced pool pressure
+    drives /healthz to 503 with a structured reason, the fleet rollup is
+    worst-of, and the window aging out (fake clock) recovers it to 200 —
+    deterministically."""
+    from paddle_tpu.inference.obs_server import ObservabilityServer
+    cfg, params = tiny
+    clk = FakeClock(0.0)
+    eng = LLMEngine(params, cfg, num_slots=2, page_size=8, max_model_len=64,
+                    prefill_chunk=8, admission="optimistic",
+                    preempt="recompute", clock=clk, double_buffer=False,
+                    fault_plan=FaultPlan(pressure_steps=(2, 3, 4, 5, 6, 7)))
+    eng.add_request(np.arange(4, dtype=np.int32), max_new_tokens=20,
+                    priority=0)
+    eng.add_request(np.arange(4, 6, dtype=np.int32), max_new_tokens=20,
+                    priority=1)
+    steps = 0
+    while eng.has_work and eng.stats()["preemptions"] < 3 and steps < 100:
+        clk.t += 0.1
+        eng.step()
+        steps += 1
+    st = eng.stats()
+    assert st["preemptions"] >= 3
+    # >= 3 preemptions inside ~a second of engine time: far over the 1/s
+    # overload threshold on the 10s window
+    assert st["rates"]["preemptions_per_sec"]["10s"] >= 1.0
+    healthy = LLMEngine(params, cfg, num_slots=1, page_size=8,
+                        max_model_len=64, clock=clk)
+    fleet = FleetMetrics().add("sick", eng).add("fine", healthy)
+    with ObservabilityServer(eng) as srv, \
+            ObservabilityServer(fleet=fleet) as fsrv:
+        code, text = _http_get(srv.url + "/healthz")
+        body = json.loads(text)
+        assert code == 503
+        assert body["state"] == "overloaded"
+        assert body["signals"]["preemption"]["state"] == "overloaded"
+        assert any(r.startswith("preemption") for r in body["reasons"])
+        # fleet mode: worst-of rollup + per-engine detail
+        code, text = _http_get(fsrv.url + "/healthz")
+        fb = json.loads(text)
+        assert code == 503 and fb["state"] == "overloaded"
+        assert fb["engines"]["sick"]["state"] == "overloaded"
+        assert fb["engines"]["fine"]["state"] == "ok"
+        # recovery: the preemption burst ages past the window — 200/ok
+        # again with zero resets, on both surfaces
+        clk.t += 400.0
+        code, text = _http_get(srv.url + "/healthz")
+        assert code == 200 and json.loads(text)["state"] == "ok"
+        code, text = _http_get(fsrv.url + "/healthz")
+        fb = json.loads(text)
+        assert code == 200 and fb["state"] == "ok"
+        # a wedged engine (health evaluation raises) is 503, never 200 —
+        # the bug the hardcoded {"ok": true} stub had
+        eng._rw_preemptions = None              # wreck it
+        code, text = _http_get(srv.url + "/healthz")
+        body = json.loads(text)
+        assert code == 503 and body["state"] == "error"
+        assert "health evaluation failed" in body["reasons"][0]
+        # error payloads keep the report shape probes read (code/signals)
+        assert body["code"] == 3 and body["signals"] == {}
+        # the postmortem surfaces survive the wrecked signal plane too:
+        # stats() degrades to an error health entry instead of raising,
+        # so the debug bundle (which embeds it) still assembles
+        st_err = eng.stats()
+        assert st_err["health"]["state"] == "error"
+        assert "health evaluation failed" in st_err["health"]["reasons"][0]
+        assert "requests" in eng.debug_bundle()
+    # drain what's left so the fixture engines don't leak state
+    eng._rw_preemptions = eng.metrics._rate_windows["preemptions_per_sec"]
+    while eng.has_work:
+        clk.t += 0.1
+        eng.step()
+
+
+def test_health_gauge_fleet_merge_worst_of():
+    """The engine_health gauge declares agg='max': a fleet with a degraded
+    (1) and an overloaded (2) member reads 2 — worst-of, not the
+    nonsensical sum 3."""
+    from paddle_tpu.inference.metrics import FleetMetrics, MetricsRegistry
+    a, b = MetricsRegistry(namespace="llm_engine"), \
+        MetricsRegistry(namespace="llm_engine")
+    a.gauge("engine_health", agg="max").set(1.0)
+    b.gauge("engine_health", agg="max").set(2.0)
+    fleet = FleetMetrics().add("e0", a).add("e1", b)
+    assert fleet.merged().get("engine_health").value == 2.0
+    snap = fleet.snapshot()
+    assert snap["fleet"]["gauges"]["engine_health"] == 2.0
+    assert snap["engines"]["e0"]["gauges"]["engine_health"] == 1.0
+
+
+def test_roofline_drift_and_recompile_anomaly(tiny, monkeypatch):
+    """The live roofline: warm_decode arms predicted_step_ms once (cached,
+    zero dispatches), busy steps feed the measured EWMA and the drift
+    gauge; the alert counter counts band-excursion TRANSITIONS; the
+    steady-state recompile counter moves exactly on executable-count
+    growth after warm and degrades health; reset_counters re-seeds it all."""
+    from paddle_tpu.analysis.registry import SERVE_SLO
+    cfg, params = tiny
+    eng = LLMEngine(params, cfg, num_slots=2, page_size=8, max_model_len=64)
+    assert eng.stats()["roofline"]["predicted_step_ms"] is None
+    assert eng.metrics.snapshot()["gauges"]["roofline_drift"] == 0.0
+    eng.warm_decode()                       # arms the prediction
+    p = eng.stats()["roofline"]["predicted_step_ms"]
+    assert p is not None and p > 0
+    assert eng.predicted_step_ms == p       # cached: one trace ever
+    eng.add_request(np.arange(6, dtype=np.int32), max_new_tokens=4)
+    eng.run()
+    st = eng.stats()["roofline"]
+    assert st["measured_step_ms"] > 0       # real clock: busy steps fed it
+    assert st["drift"] == pytest.approx(st["measured_step_ms"] / p)
+    assert eng.metrics.snapshot()["gauges"]["roofline_drift"] == \
+        pytest.approx(st["drift"])
+    assert st["steady_state_recompiles"] == 0   # fixed shapes: never
+    # drift-band alerts count transitions, not steps spent out of band
+    # (establish a known in-band state first: on a CPU host the real run's
+    # drift may already sit outside the declared band)
+    monkeypatch.setitem(SERVE_SLO, "roofline_drift_band", (1e-9, 1e9))
+    eng._note_steady_state(0.001)
+    assert eng._drift_violation is False
+    alerts0 = eng._roofline_alerts.value
+    monkeypatch.setitem(SERVE_SLO, "roofline_drift_band", (1e-9, 1e-8))
+    eng._note_steady_state(0.001)           # excursion begins: +1
+    eng._note_steady_state(0.001)           # still out: no double count
+    assert eng._roofline_alerts.value == alerts0 + 1
+    monkeypatch.setitem(SERVE_SLO, "roofline_drift_band", (1e-9, 1e9))
+    eng._note_steady_state(0.001)           # back in band
+    monkeypatch.setitem(SERVE_SLO, "roofline_drift_band", (1e-9, 1e-8))
+    eng._note_steady_state(0.001)           # second excursion: +1
+    assert eng._roofline_alerts.value == alerts0 + 2
+    # steady-state recompile anomaly: decode-side cache growth after the
+    # baseline step is counted and degrades health
+    class _Growing:
+        n = 1
+
+        def _cache_size(self):
+            return self.n
+
+    fake = _Growing()
+    monkeypatch.setattr(eng, "_decode_fn", fake)
+    eng._exec_baseline = None
+    eng._note_steady_state(0.001)           # baseline fixed at 1
+    assert eng._ss_recompiles.value == 0
+    fake.n = 3
+    eng._note_steady_state(0.001)           # grew after warm: anomaly
+    assert eng._ss_recompiles.value == 2
+    h = eng.health()
+    assert h["signals"]["recompiles"]["state"] == "degraded"
+    assert h["state"] != "ok"
+    assert any(r.startswith("recompiles") for r in h["reasons"])
+    # the reset contract: counters, EWMA and the baseline re-seed; the
+    # static prediction survives (a property of shapes, not of a run)
+    eng.reset_counters()
+    st = eng.stats()["roofline"]
+    assert st["steady_state_recompiles"] == 0 and st["drift_alerts"] == 0
+    assert st["measured_step_ms"] is None and st["drift"] is None
+    assert st["predicted_step_ms"] == p
+
+
+def test_check_bench_tool(tiny, tmp_path):
+    """Satellite (CI wiring): the trajectory row projects from a real
+    run_serve_bench result and validates; SERVE_PERF_FLOORS (declared once
+    in the analysis registry) pass the real row and catch tampered parity /
+    dispatch / overhead / roofline values; append + read round-trips and
+    malformed history lines are named."""
+    import tools.check_bench as cb
+    from bench_serve import run_serve_bench
+    cfg, params = tiny
+    result = run_serve_bench(config=cfg, params=params, num_requests=6,
+                             num_slots=2, page_size=8, max_model_len=64,
+                             max_new_tokens=4, prefill_chunk=8, spec_len=2,
+                             debug_bundle_dir="")
+    row = cb.bench_row(result)
+    assert cb.validate_row(row) == []
+    assert cb.check_floors(row) == []           # the real row passes
+    assert row["mode"]["fused"] is True and row["mode"]["mp"] == 1
+    assert row["perf"]["dispatches_per_step"] <= 1.0
+    assert row["perf"]["model_error"] > 0
+    # floors catch each declared regression class
+    bad = json.loads(json.dumps(row))
+    bad["parity"]["fuse_parity"] = False
+    assert any("fuse_parity" in e for e in cb.check_floors(bad))
+    bad = json.loads(json.dumps(row))
+    bad["perf"]["dispatches_per_step"] = 2.0
+    assert any("dispatches_per_step" in e for e in cb.check_floors(bad))
+    bad = json.loads(json.dumps(row))
+    bad["perf"]["tracing_overhead_measured"] = 0.5
+    bad["perf"]["tracing_overhead"] = 0.5
+    assert any("tracing overhead" in e for e in cb.check_floors(bad))
+    bad["perf"]["tracing_overhead"] = None      # raw-run shape: only the
+    assert any("tracing overhead" in e         # measured account exists —
+               for e in cb.check_floors(bad))  # the bar must still bind
+    bad = json.loads(json.dumps(row))
+    bad["perf"]["model_error"] = None
+    assert any("model_error" in e for e in cb.check_floors(bad))
+    # schema-versioned append + read round-trip
+    hist = tmp_path / "BENCH_SERVE.jsonl"
+    cb.append_bench_row(result, path=str(hist))
+    cb.append_bench_row(result, path=str(hist))
+    rows, errors = cb.read_history(str(hist))
+    assert len(rows) == 2 and errors == []
+    assert rows[0][1]["schema_version"] == cb.ROW_SCHEMA_VERSION
+    with open(hist, "a") as f:
+        f.write("not json\n")
+    _, errors = cb.read_history(str(hist))
+    assert errors and "not JSON" in errors[0]
+    # a bench that cannot produce a valid row fails loudly
+    with pytest.raises(ValueError, match="trajectory row"):
+        cb.append_bench_row({"garbage": True}, path=str(hist))
+    # CLI: default mode schema-checks the history file
+    assert cb.main(["--history", str(hist)]) == 1       # the bad line
+    # a red run must not mutate the trajectory: the history pass runs
+    # BEFORE any append, so a rerun cannot stack duplicate rows
+    res_json = tmp_path / "res.json"
+    res_json.write_text(json.dumps(result))
+    size_before = hist.stat().st_size
+    assert cb.main(["--history", str(hist),
+                    "--from-json", str(res_json)]) == 1
+    assert hist.stat().st_size == size_before
+    hist2 = tmp_path / "clean.jsonl"
+    cb.append_bench_row(result, path=str(hist2))
+    assert cb.main(["--history", str(hist2)]) == 0
+    # a green --from-json run IS a trajectory point
+    assert cb.main(["--history", str(hist2),
+                    "--from-json", str(res_json)]) == 0
+    assert len(cb.read_history(str(hist2))[0]) == 2
 
 
 def test_check_metrics_tool(tmp_path):

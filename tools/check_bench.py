@@ -1,0 +1,272 @@
+#!/usr/bin/env python
+"""CI guard + trajectory keeper for the serving bench.
+
+The HBM/collective/program budgets are declared once and re-measured every
+run (`tools/tpu_cost.py`, `tools/check_program_count.py`); serving PERF had
+no such discipline — each PR's `bench_serve.py` JSON line scrolled away and
+nothing noticed a regression until a human did.  This tool closes that gap:
+
+- **Trajectory** (`BENCH_SERVE.jsonl`): every bench run appends ONE
+  schema-versioned row — the mode axes that make rows comparable across PRs
+  (mp, fuse, spec, dtypes, oversubscribe, tracing) plus the key perf
+  metrics (tokens/s, goodput, dispatches/step, host-sync ms, fused_speedup,
+  parity flags, tracing overhead, roofline predicted/measured/model_error).
+  `bench_serve.py` writes the row by default (`--no-history` opts out)
+  through `append_bench_row()` here, so the row shape and its validator
+  live in one file.
+- **Floors** (`--ci`): runs a fresh CPU-smoke bench (subprocess, exactly
+  what a human would run) and enforces `SERVE_PERF_FLOORS` — declared ONCE
+  in `paddle_tpu/analysis/registry.py` next to the resource budgets: every
+  parity flag true, dispatches/step within the decode-side program budget,
+  fused_speedup over its floor, the deterministic tracing account under 2%,
+  model_error a sane positive ratio.  The passing row is appended, so a
+  green CI run IS a trajectory point.
+
+Exits non-zero with a diff on violation.  Usage:
+    JAX_PLATFORMS=cpu python tools/check_bench.py --ci      # bench + floors
+    python tools/check_bench.py                             # history schema
+    python tools/check_bench.py --from-json out.json        # external row
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_HISTORY = os.path.join(_REPO, "BENCH_SERVE.jsonl")
+
+ROW_SCHEMA_VERSION = 1
+
+# the axes that make rows comparable across PRs: two rows agree on "mode"
+# or their perf numbers are not the same experiment
+MODE_AXES = ("mp", "fused", "spec_len", "prefill_chunk", "weight_dtype",
+             "kv_dtype", "oversubscribe", "preempt_mode", "admission",
+             "request_tracing")
+# the perf surface a trajectory reader plots; absent-in-this-mode metrics
+# (e.g. goodput_ratio without --oversubscribe) ride as null
+PERF_KEYS = ("decode_tokens_per_sec_per_chip", "generated_tokens_per_sec",
+             "goodput_tokens_per_sec", "goodput_ratio",
+             "dispatches_per_step", "host_sync_ms_per_step",
+             "predicted_step_ms", "measured_step_ms", "model_error",
+             "roofline_drift", "steady_state_recompiles",
+             "fused_speedup", "spec_speedup", "accepted_per_step",
+             "tracing_overhead", "tracing_overhead_measured",
+             "preemptions_per_step", "prefix_hit_rate",
+             "ttft_p50_ms", "ttft_p99_ms", "tpot_p99_ms",
+             "requests", "elapsed_s", "device_spec")
+PARITY_KEYS = ("fuse_parity", "spec_parity", "oversubscribe_parity",
+               "tracing_parity")
+REQUIRED_ROW_KEYS = frozenset({"schema_version", "t", "mode", "perf",
+                               "parity"})
+
+
+def bench_row(stats, t=None):
+    """Project one `bench_serve` result dict onto the trajectory row."""
+    return {
+        "schema_version": ROW_SCHEMA_VERSION,
+        "t": time.time() if t is None else float(t),
+        "mode": {k: stats.get(k) for k in MODE_AXES},
+        "perf": {k: stats.get(k) for k in PERF_KEYS},
+        # only the parity flags this run's comparison passes produced
+        "parity": {k: stats[k] for k in PARITY_KEYS if k in stats},
+    }
+
+
+def validate_row(row):
+    """Schema check for one trajectory row; returns error strings."""
+    errors = []
+    if not isinstance(row, dict):
+        return [f"row is not an object: {type(row).__name__}"]
+    missing = REQUIRED_ROW_KEYS - set(row)
+    if missing:
+        errors.append(f"row missing keys: {sorted(missing)}")
+        return errors
+    if row["schema_version"] != ROW_SCHEMA_VERSION:
+        errors.append(f"schema_version {row['schema_version']!r} != "
+                      f"{ROW_SCHEMA_VERSION} (migrate the row or bump the "
+                      f"reader)")
+    if not isinstance(row["t"], (int, float)) or row["t"] <= 0:
+        errors.append(f"bad timestamp t={row['t']!r}")
+    for section, keys in (("mode", MODE_AXES), ("perf", PERF_KEYS)):
+        if not isinstance(row[section], dict):
+            errors.append(f"row[{section!r}] is not an object")
+            continue
+        miss = set(keys) - set(row[section])
+        if miss:
+            errors.append(f"row[{section!r}] missing axes: {sorted(miss)}")
+    if not isinstance(row["parity"], dict):
+        errors.append("row['parity'] is not an object")
+    tok = (row.get("perf") or {}).get("decode_tokens_per_sec_per_chip")
+    if not isinstance(tok, (int, float)):
+        errors.append(f"perf.decode_tokens_per_sec_per_chip is not a "
+                      f"number: {tok!r}")
+    return errors
+
+
+def check_floors(row, floors=None):
+    """Enforce `SERVE_PERF_FLOORS` on one row; returns error strings.  Mode-
+    conditional bars (dispatch cap, fused_speedup) apply only where the row's
+    mode reaches them; the parity and tracing bars apply wherever the run
+    produced the number."""
+    if floors is None:
+        from paddle_tpu.analysis.registry import SERVE_PERF_FLOORS
+        floors = SERVE_PERF_FLOORS
+    errors = []
+    perf = row.get("perf") or {}
+    mode = row.get("mode") or {}
+    for k in floors["parity_flags"]:
+        v = row.get("parity", {}).get(k)
+        if v is not None and v is not True:
+            errors.append(f"parity flag {k} is {v!r} — byte-exact parity is "
+                          f"the one bar noise cannot excuse")
+    tok = perf.get("decode_tokens_per_sec_per_chip")
+    if not isinstance(tok, (int, float)) or \
+            tok < floors["tokens_per_sec_min"]:
+        errors.append(f"decode_tokens_per_sec_per_chip {tok!r} below "
+                      f"{floors['tokens_per_sec_min']}")
+    if mode.get("fused"):
+        d = perf.get("dispatches_per_step")
+        cap = floors["dispatches_per_step_max"]
+        if not isinstance(d, (int, float)) or d > cap + 1e-9:
+            errors.append(f"dispatches_per_step {d!r} exceeds the declared "
+                          f"{cap} (the one-dispatch claim broke)")
+        fs = perf.get("fused_speedup")
+        if fs is not None and fs < floors["fused_speedup_min"]:
+            errors.append(f"fused_speedup {fs} below the declared floor "
+                          f"{floors['fused_speedup_min']}")
+    # bench_row fills absent keys with None, so fall back on None — not
+    # just on a missing key — or a raw run_serve_bench row (which carries
+    # only the measured account) would skip the tracing bar entirely
+    overhead = perf.get("tracing_overhead")
+    if overhead is None:
+        overhead = perf.get("tracing_overhead_measured")
+    if overhead is not None and overhead >= floors["tracing_overhead_max"]:
+        errors.append(f"tracing overhead {overhead} at or above the "
+                      f"{floors['tracing_overhead_max']} bar")
+    me = perf.get("model_error")
+    if me is None or not (0.0 < me <= floors["model_error_max"]):
+        errors.append(f"model_error {me!r} outside "
+                      f"(0, {floors['model_error_max']}] — the roofline "
+                      f"prediction is missing or broken")
+    return errors
+
+
+def append_bench_row(stats, path=DEFAULT_HISTORY, t=None):
+    """`bench_serve.py`'s post-run hook: build, validate and append the
+    trajectory row; returns it.  Raises ValueError on a malformed result —
+    a bench that cannot produce a valid row must fail loudly, not seed the
+    trajectory with garbage."""
+    row = bench_row(stats, t=t)
+    errors = validate_row(row)
+    if errors:
+        raise ValueError(f"bench result does not project onto a valid "
+                         f"trajectory row: {errors}")
+    with open(path, "a") as f:
+        f.write(json.dumps(row, sort_keys=True) + "\n")
+    return row
+
+
+def read_history(path=DEFAULT_HISTORY):
+    """((line_no, row) pairs, error strings) for every line of the
+    trajectory file; a missing file is an empty (valid) trajectory."""
+    rows, errors = [], []
+    if not os.path.exists(path):
+        return rows, errors
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            if not line.strip():
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError as e:
+                errors.append(f"{path}:{i}: not JSON: {e}")
+                continue
+            errors.extend(f"{path}:{i}: {e}" for e in validate_row(row))
+            rows.append((i, row))
+    return rows, errors
+
+
+def run_ci_bench():
+    """Run the CPU-smoke bench exactly as a human would (subprocess,
+    `--no-history` so THIS tool owns the append) and return its result
+    dict."""
+    import subprocess
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench_serve.py"),
+         "--no-history"],
+        capture_output=True, text=True, cwd=_REPO, env=env, timeout=1800)
+    if proc.returncode != 0:
+        raise RuntimeError(f"bench_serve.py failed (rc={proc.returncode}):\n"
+                           f"{proc.stderr[-4000:]}")
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(f"no JSON line in bench_serve.py output:\n"
+                       f"{proc.stdout[-2000:]}")
+
+
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ci", action="store_true",
+                    help="run a fresh CPU-smoke bench, enforce "
+                         "SERVE_PERF_FLOORS, append the passing row")
+    ap.add_argument("--from-json", type=str, default=None,
+                    help="validate + floor-check an existing bench_serve "
+                         "result JSON (the printed line) instead of running")
+    ap.add_argument("--history", type=str, default=DEFAULT_HISTORY,
+                    help="trajectory file (default BENCH_SERVE.jsonl at the "
+                         "repo root)")
+    ap.add_argument("--no-append", action="store_true",
+                    help="check only; do not append the row")
+    args = ap.parse_args(argv)
+
+    errors = []
+    row = None
+    stats = None
+    if args.ci:
+        stats = run_ci_bench()
+    elif args.from_json:
+        with open(args.from_json) as f:
+            stats = json.load(f)
+    if stats is not None:
+        row = bench_row(stats)
+        errors.extend(validate_row(row))
+        errors.extend(check_floors(row))
+    # the drop-in schema pass over the whole trajectory (also the default
+    # no-args mode) runs BEFORE any append: a red run must not mutate the
+    # trajectory (reruns would stack duplicate rows on a broken history) —
+    # a green CI run IS a trajectory point, a red one leaves no trace
+    rows, hist_errors = read_history(args.history)
+    errors.extend(hist_errors)
+    if row is not None and not errors and not args.no_append:
+        with open(args.history, "a") as f:
+            f.write(json.dumps(row, sort_keys=True) + "\n")
+        rows.append((len(rows) + 1, row))
+
+    report = {"metric": "serve_bench_trajectory", "ok": not errors,
+              "history": args.history, "history_rows": len(rows),
+              "appended": bool(row is not None and not errors
+                               and not args.no_append),
+              "errors": errors}
+    if row is not None:
+        report["row_perf"] = {
+            k: row["perf"].get(k)
+            for k in ("decode_tokens_per_sec_per_chip", "dispatches_per_step",
+                      "fused_speedup", "tracing_overhead", "model_error")}
+        report["row_parity"] = row["parity"]
+    for e in errors:
+        print(f"FAIL: {e}", file=sys.stderr)
+    print(json.dumps(report))
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,6 +29,13 @@ or make a "counter" go backwards:
   an ephemeral loopback port: /metrics parses under this same checker,
   /stats carries the required keys, /requests/<rid> serves the exemplar's
   span tree, /debug is valid JSON with the bundle schema;
+- **health & signals schema** — `stats()` carries the windowed-rate block
+  (every family over every window), a folded `health` state from the known
+  set with burn rates, and the complete `roofline` account; the exposition
+  carries the rate/burn/health/roofline gauge families; `/healthz` serves
+  the REAL health evaluation (structured state + per-signal detail, 200 for
+  ok/degraded, 503 for overloaded — never the old hardcoded stub); and the
+  `engine_health` gauge fleet-merges WORST-OF (max), not sum;
 - **monotonicity** — across a CPU-smoke engine loop that exercises admission,
   chunked prefill, speculative verify, prefix hits, LRU eviction AND abort,
   no counter ever decreases between steps;
@@ -73,11 +80,25 @@ REQUIRED_STATS_KEYS = frozenset({
     # observability-plane PR (ISSUE 12): the SLO block (deadline attainment
     # + per-priority-class goodput) the router's SLO layer consumes
     "slo",
+    # health & signals PR (ISSUE 13): windowed rates, the folded health
+    # state, and the live roofline (predicted/measured/drift/anomalies)
+    "rates", "health", "roofline",
 })
 REQUIRED_SLO_KEYS = frozenset({
     "deadline_requests", "deadline_met", "deadline_attainment",
     "goodput_tokens_by_priority",
 })
+# stats()["rates"] families x window labels (inference.metrics.RATE_WINDOWS);
+# each (family, window) pair is ALSO a pull gauge in the exposition
+RATE_FAMILIES = ("tokens_per_sec", "admits_per_sec", "preemptions_per_sec",
+                 "timeouts_per_sec", "rejects_per_sec")
+RATE_WINDOW_LABELS = ("10s", "1m", "5m")
+REQUIRED_HEALTH_KEYS = frozenset({"state", "code", "reasons", "burn_rates"})
+REQUIRED_ROOFLINE_KEYS = frozenset({
+    "predicted_step_ms", "measured_step_ms", "drift", "drift_alerts",
+    "steady_state_recompiles",
+})
+HEALTH_STATES = ("ok", "degraded", "overloaded")
 REQUIRED_LATENCY_KEYS = frozenset(
     {"queue_s", "ttft_s", "tpot_s", "e2e_s", "step_s"})
 REQUIRED_COUNTERS = frozenset({
@@ -89,6 +110,8 @@ REQUIRED_COUNTERS = frozenset({
     "preemptions", "preempt_swaps", "preempt_recomputes", "swapped_pages",
     "swap_ms", "recomputed_tokens", "timeouts", "rejected_requests",
     "intake_swap_rejects", "deadline_requests", "deadline_met",
+    # health & signals PR: admission-rate numerator + anomaly counters
+    "admitted_requests", "roofline_drift_alerts", "steady_state_recompiles",
 })
 REQUIRED_DEBUG_BUNDLE_KEYS = frozenset({
     "version", "t", "engine", "pool", "requests", "step_trace", "stats",
@@ -98,7 +121,13 @@ REQUIRED_GAUGES = frozenset({
     "queued", "prefilling", "running", "kv_pages_in_use", "kv_pages_free",
     "kv_pages_evictable", "prefix_cached_pages", "kv_pages_swapped",
     "kv_pool_pressure", "kv_pool_bytes",
-})
+    # health & signals PR: the folded health code (worst-of fleet merge),
+    # the live roofline pair, and the SLO burn-rate pair
+    "engine_health", "measured_step_ms", "roofline_drift",
+    "slo_burn_rate_1m", "slo_burn_rate_5m",
+}) | frozenset(
+    # windowed-rate pull gauges: one per (family, window)
+    f"{fam}_{w}" for fam in RATE_FAMILIES for w in RATE_WINDOW_LABELS)
 REQUIRED_HISTOGRAMS = frozenset({
     "queue_time_seconds", "ttft_seconds", "tpot_seconds",
     "e2e_latency_seconds", "step_seconds",
@@ -369,6 +398,24 @@ def check_merge_and_fleet(eng, errors):
     if unscoped:
         errors.append(f"fleet per-engine exemplar trace handles missing "
                       f"?engine= scope: {unscoped[:3]}")
+    # health gauge fleet fold: a fleet with one degraded (1) and one
+    # overloaded (2) member must merge WORST-OF (2) — a sum (3) would
+    # invent a state past "overloaded" and a healthy+sick pair would read
+    # sick twice as hard as it is
+    ha_, hb_ = MetricsRegistry(namespace="m"), MetricsRegistry(namespace="m")
+    ha_.gauge("engine_health", agg="max").set(1.0)
+    hb_.gauge("engine_health", agg="max").set(2.0)
+    merged_h = FleetMetrics().add("e0", ha_).add("e1", hb_).merged()
+    got = merged_h.get("engine_health").value
+    if got != 2.0:
+        errors.append(f"engine_health fleet merge is not worst-of: "
+                      f"max(1, 2) merged to {got} (sum semantics leaked in)")
+    # and the live engine's own health gauge max-folds with itself
+    same = FleetMetrics().add("a", eng).add("b", eng).merged()
+    one = eng.metrics.get("engine_health").value
+    if same.get("engine_health").value != one:
+        errors.append(f"engine_health self-merge {same.get('engine_health').value} "
+                      f"!= member value {one} (agg must be max)")
 
 
 def check_obs_server(eng, rid, errors):
@@ -425,6 +472,21 @@ def check_obs_server(eng, rid, errors):
         missing = REQUIRED_DEBUG_BUNDLE_KEYS - set(bundle)
         if status != 200 or missing:
             errors.append(f"/debug -> {status}, missing {sorted(missing)}")
+        # /healthz is the REAL health evaluation now: a structured state
+        # with per-signal detail, never the old hardcoded {"ok": true}
+        status, text = get(srv, "/healthz")
+        health = json.loads(text)
+        if set(health) == {"ok"}:
+            errors.append("/healthz is still the hardcoded liveness stub")
+        if health.get("state") not in HEALTH_STATES:
+            errors.append(f"/healthz state {health.get('state')!r} unknown")
+        if status not in (200, 503) or \
+                (status == 503) != (health.get("state") == "overloaded"):
+            errors.append(f"/healthz -> {status} with state "
+                          f"{health.get('state')!r} (want 200 for "
+                          f"ok/degraded, 503 for overloaded)")
+        if "signals" not in health:
+            errors.append("/healthz carries no per-signal detail")
 
 
 def main() -> int:
@@ -441,6 +503,25 @@ def main() -> int:
         slo_missing = REQUIRED_SLO_KEYS - set(st["slo"])
         if slo_missing:
             errors.append(f"stats()['slo'] missing: {sorted(slo_missing)}")
+        # health & signals PR: the rates block carries every family over
+        # every window, health folds to a known state, roofline is complete
+        rates = st["rates"]
+        miss = set(RATE_FAMILIES) - set(rates)
+        if miss:
+            errors.append(f"stats()['rates'] missing families: {sorted(miss)}")
+        for fam in RATE_FAMILIES:
+            wmiss = set(RATE_WINDOW_LABELS) - set(rates.get(fam, {}))
+            if wmiss:
+                errors.append(f"stats()['rates'][{fam!r}] missing windows: "
+                              f"{sorted(wmiss)}")
+        hmiss = REQUIRED_HEALTH_KEYS - set(st["health"])
+        if hmiss:
+            errors.append(f"stats()['health'] missing: {sorted(hmiss)}")
+        elif st["health"]["state"] not in HEALTH_STATES:
+            errors.append(f"unknown health state {st['health']['state']!r}")
+        rmiss = REQUIRED_ROOFLINE_KEYS - set(st["roofline"])
+        if rmiss:
+            errors.append(f"stats()['roofline'] missing: {sorted(rmiss)}")
 
     snap = eng.metrics.snapshot()
     for section, required in (("counters", REQUIRED_COUNTERS),
