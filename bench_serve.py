@@ -54,6 +54,16 @@ earn nothing) and, from the unpressured comparison pass main() runs
 alongside, `goodput_ratio` + byte-exact `oversubscribe_parity`; page/swap
 accounting is invariant-checked at drain.
 
+`--multi-turn N` replays multi-turn chat sessions (each request re-submits
+its whole conversation, N turns, `--session-return-frac F` of sessions
+returning) — the KV-tier workload: with tiering on (default; `--no-kv-tier`
+disables, `--spill-dir D` adds a disk level) a returning session's evicted
+conversation KV restores with ONE h2d scatter instead of a full re-prefill.
+The JSON carries `resume_hits`/`resume_restored_tokens`/`partial_page_hits`
+and the returning-turn-only `returning_prefilled_tokens` + TTFT; main() runs
+a `--no-kv-tier` pass on the same stream for `returning_prefilled_drop` and
+byte-exact `kv_tier_parity`.
+
 `--mp N` serves tensor-parallel over N chips: Megatron-sharded serving params
 (qkv/fc1 column-, proj/fc2 row-split), page pool head-sharded, paged
 attention per-chip on the local head slice.  Greedy outputs are
@@ -96,6 +106,8 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
                     shared_prefix_frac=0.0, spec_len=0, mp=1, fuse=True,
                     oversubscribe=0.0, preempt="recompute",
                     weight_dtype=None, kv_dtype=None,
+                    kv_tier=True, spill_dir=None,
+                    multi_turn=1, session_return_frac=1.0,
                     trace_dir=None, request_tracing=True,
                     debug_bundle_dir="serve_debug"):
     """Replay a Poisson request stream through LLMEngine; returns the metrics
@@ -121,6 +133,26 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
     recompute split and `goodput_tokens_per_sec` (tokens in FINAL outputs
     per second — replayed prefill work earns nothing), and the page/swap
     accounting is invariant-checked at drain.
+
+    multi_turn=N (> 1) switches the stream to MULTI-TURN CHAT sessions —
+    the dominant traffic shape the KV tier exists for: each of the
+    `num_requests` sessions re-submits its whole conversation
+    (previous prompt + generated reply + a fresh user chunk) as the next
+    turn's prompt, up to N turns; `session_return_frac` is the fraction of
+    sessions that return after turn 1.  Follow-up turns enqueue the moment
+    the previous turn finishes, so concurrent sessions thrash the device
+    prefix cache between a session's visits — exactly the eviction pattern
+    that makes the tier matter.  kv_tier=True (default; `--no-kv-tier`
+    disables) lets evicted session KV spill to the bounded host tier
+    (+ optional `spill_dir` disk level) and restore by one scatter; the
+    returned `resume_hits`/`resume_restored_tokens` and the
+    returning-turn-only `returning_prefilled_tokens` /
+    `returning_ttft_p50_ms` quantify the win, and main()'s `--no-kv-tier`
+    comparison pass reports `returning_prefilled_drop` + byte-exact
+    `kv_tier_parity` on the same stream.  In multi-turn mode the
+    outputs digest orders streams by (session, turn) — request ids are
+    assigned in finish order, which scheduling may permute between
+    passes — so parity compares conversations, not id assignment.
 
     weight_dtype/kv_dtype ("int8" or None/"bf16") run the engine quantized
     (weight-only int8 params / int8 KV page pool).  Under oversubscribe an
@@ -176,6 +208,40 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
             if np.isfinite(request_rate) else np.zeros(num_requests))
     arrivals = np.cumsum(gaps)
 
+    # multi-turn chat sessions: clamp first-turn prompts so the LAST turn's
+    # context (prompt + every reply + every fresh user chunk) still fits,
+    # pre-draw the per-turn user chunks and each session's turn count NOW
+    # (identical randomness across the tier/no-tier/spec/fuse comparison
+    # passes), and size the host pool to hold every session's final context
+    # so the capacity tier — not its eviction policy — is what is measured
+    swap_pool_pages = None
+    turn_chunks = {}
+    session_turns = [1] * num_requests
+    if multi_turn < 1:
+        raise ValueError(f"multi_turn must be >= 1, got {multi_turn}")
+    if multi_turn > 1:
+        user_chunk = max(2, page_size // 2)
+        reserve = (multi_turn - 1) * (max_new_tokens + user_chunk)
+        if reserve >= max_prompt:
+            raise ValueError(
+                f"multi_turn={multi_turn} needs {reserve} growth tokens but "
+                f"max_model_len leaves only {max_prompt} prompt tokens")
+        prompts = [p[:max(1, max_prompt - reserve)] for p in prompts]
+        session_turns = [multi_turn if rng.rand() < session_return_frac else 1
+                         for _ in range(num_requests)]
+        turn_chunks = {
+            (s, t): rng.randint(0, config.vocab_size,
+                                (user_chunk,)).astype(np.int32)
+            for s in range(num_requests)
+            for t in range(2, session_turns[s] + 1)}
+        if kv_tier and not (oversubscribe and oversubscribe > 0):
+            total_pages = sum(
+                -(-(int(prompts[s].size) + (session_turns[s] - 1) *
+                    (max_new_tokens + user_chunk) + max_new_tokens)
+                  // page_size)
+                for s in range(num_requests))
+            swap_pool_pages = total_pages
+
     admission = "reservation"
     num_pages = None
     if oversubscribe and oversubscribe > 0:
@@ -209,6 +275,8 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
                     max_model_len=max_model_len, prefill_chunk=prefill_chunk,
                     prefix_cache=prefix_cache, spec_len=spec_len, fuse=fuse,
                     admission=admission, preempt=preempt,
+                    kv_tier=kv_tier, spill_dir=spill_dir,
+                    swap_pool_pages=swap_pool_pages,
                     weight_dtype=weight_dtype, kv_dtype=kv_dtype,
                     mp=mp if mp and mp > 1 else None,
                     request_tracing=request_tracing,
@@ -252,8 +320,10 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
                                         # optimistic + preempt="swap")
     eng.reset_counters()
 
-    pending = list(zip(arrivals, prompts))
+    pending = list(zip(arrivals, prompts, range(num_requests)))
     outs = []
+    rid_session = {}        # rid -> (session, turn); turn 1 is the opener
+    expected_total = sum(session_turns)
     # host-side capture only (spans + step timeline + metrics): a jax device
     # capture over a whole bench run would dominate the timed section and
     # turn the headline tokens/s into a profiler benchmark — for device
@@ -280,14 +350,30 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
             while pending or eng.has_work:
                 now = time.perf_counter() - t0
                 while pending and pending[0][0] <= now:
-                    _, p = pending.pop(0)
-                    eng.add_request(p, max_new_tokens=max_new_tokens)
+                    _, p, s = pending.pop(0)
+                    rid_session[eng.add_request(
+                        p, max_new_tokens=max_new_tokens)] = (s, 1)
                 if eng.has_work:
-                    outs.extend(eng.step())
+                    fin = eng.step()
+                    outs.extend(fin)
+                    # returning sessions: the moment a turn finishes, the
+                    # session comes back with its WHOLE conversation as the
+                    # next prompt (+ a fresh pre-drawn user chunk) — the
+                    # multi-turn traffic shape the KV tier restores
+                    for o in fin:
+                        s, t = rid_session[o.request_id]
+                        if t < session_turns[s]:
+                            nxt = np.concatenate(
+                                [np.asarray(o.prompt, np.int32),
+                                 np.asarray(o.token_ids, np.int32),
+                                 turn_chunks[(s, t + 1)]])
+                            rid_session[eng.add_request(
+                                nxt, max_new_tokens=max_new_tokens)] = \
+                                (s, t + 1)
                 elif pending:
                     time.sleep(min(pending[0][0] - now, 0.01))
             dt = time.perf_counter() - t0
-        assert len(outs) == num_requests, (len(outs), num_requests)
+        assert len(outs) == expected_total, (len(outs), expected_total)
         # drain invariant: free/LRU/in-use/swapped page partition exact, zero
         # leaked pages — the oversubscribed run's hard acceptance bar, and
         # cheap enough to assert on every run
@@ -313,13 +399,33 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
     # not useful work and would overstate throughput at low arrival rates
     # (with spec on, an accepted draft emits several tokens per slot-step)
     decode_tokens = st["decode_tokens"]
+    # multi-turn: order and key streams by (session, turn) — request ids are
+    # assigned in FINISH order, which scheduling may legitimately permute
+    # between comparison passes; parity is about conversations, not id
+    # assignment.  Single-turn keeps the PR-3 id-keyed digest byte-for-byte.
+    if multi_turn > 1:
+        order_key = lambda o: rid_session[o.request_id]     # noqa: E731
+        ident = lambda o: rid_session[o.request_id]         # noqa: E731
+    else:
+        order_key = lambda o: o.request_id                  # noqa: E731
+        ident = lambda o: (o.request_id,)                   # noqa: E731
     digest = hashlib.sha256()
-    for o in sorted(outs, key=lambda o: o.request_id):
+    for o in sorted(outs, key=order_key):
         # id + length delimit each stream: tokens redistributed across
         # request boundaries must not collide to the same digest
-        digest.update(np.asarray([o.request_id, len(o.token_ids)],
+        digest.update(np.asarray(list(ident(o)) + [len(o.token_ids)],
                                  np.int64).tobytes())
         digest.update(np.asarray(o.token_ids, np.int64).tobytes())
+    # returning-turn view (turn >= 2): the requests whose prefill the tier
+    # exists to eliminate — prefilled = prompt minus whatever admission
+    # served from cache (device share, tier restore, COW fraction)
+    returning = [o for o in outs if rid_session[o.request_id][1] > 1]
+    returning_prefilled = sum(
+        int(np.asarray(o.prompt).size) - int(o.cached_tokens)
+        for o in returning)
+    r_ttfts = [o.ttft_s for o in returning if o.ttft_s is not None]
+    returning_ttft_p50_ms = round(median(r_ttfts) * 1e3, 2) if r_ttfts \
+        else None
     # an mp mesh uses exactly mp chips; single-chip serving uses one program
     # on however many devices the host exposes (forced-CPU CI counts them all)
     n_chips = eng.mp if eng.mp > 1 else max(1, len(jax.devices()))
@@ -405,7 +511,24 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
         "kv_pool_bytes": st["kv_pool_bytes"],
         "intake_swap_rejects": st["intake_swap_rejects"],
         "output_tokens": [list(map(int, o.token_ids))
-                          for o in sorted(outs, key=lambda o: o.request_id)],
+                          for o in sorted(outs, key=order_key)],
+        # KV-tier / multi-turn surface: tier occupancy + spill/restore
+        # traffic, the rolling-hash partial-index hit count, and the
+        # returning-session (turn >= 2) view the tier's win is measured on
+        "kv_tier": st["kv_tier"]["enabled"],
+        "spill_dir": spill_dir,
+        "multi_turn": multi_turn,
+        "session_return_frac": session_return_frac
+                               if multi_turn > 1 else None,
+        "kv_tier_pages_host": st["kv_tier"]["pages_host"],
+        "kv_tier_pages_disk": st["kv_tier"]["pages_disk"],
+        "kv_tier_spills": st["kv_tier"]["spills"],
+        "resume_hits": st["kv_tier"]["restores"],
+        "resume_restored_tokens": st["kv_tier"]["restored_tokens"],
+        "partial_page_hits": st["kv_tier"]["partial_page_hits"],
+        "returning_requests": len(returning),
+        "returning_prefilled_tokens": returning_prefilled,
+        "returning_ttft_p50_ms": returning_ttft_p50_ms,
         "dispatches_per_step": round(dispatches_per_step, 3),
         "host_sync_ms_per_step": round(host_sync_ms, 4),
         "predicted_step_ms": round(predicted_ms, 4),
@@ -421,7 +544,8 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
         "steady_state_recompiles": st["roofline"]["steady_state_recompiles"],
         "health_state": st["health"]["state"],
         "decode_tokens_per_sec_per_chip": round(decode_tokens / dt / n_chips, 1),
-        "generated_tokens_per_sec": round(num_requests * max_new_tokens / dt, 1),
+        "generated_tokens_per_sec": round(
+            expected_total * max_new_tokens / dt, 1),
         # goodput: tokens that made it into FINAL outputs per second —
         # preempted-and-replayed prefill work earns nothing here, so the
         # recompute tax shows up as goodput < decode throughput
@@ -548,6 +672,30 @@ def main():
                          "prefix cache (recompute), or park victim KV in a "
                          "host-side pool and restore it by one h2d scatter "
                          "(swap) — the A/B axis")
+    ap.add_argument("--multi-turn", type=int, default=1,
+                    help="multi-turn chat sessions: each request becomes a "
+                         "session that re-submits its whole conversation "
+                         "(prompt + reply + a fresh user chunk) up to N "
+                         "turns, follow-ups enqueued the moment the "
+                         "previous turn finishes; with the KV tier on, "
+                         "evicted session KV restores by one scatter "
+                         "instead of re-prefilling — also runs a "
+                         "--no-kv-tier comparison pass on the same stream "
+                         "reporting returning_prefilled_drop + byte-exact "
+                         "kv_tier_parity")
+    ap.add_argument("--session-return-frac", type=float, default=1.0,
+                    help="fraction of sessions that return for turns past "
+                         "the first (multi-turn mode)")
+    ap.add_argument("--no-kv-tier", action="store_true",
+                    help="disable KV tiering: evicted prefix pages are "
+                         "dropped (the PR-10 behavior) instead of spilling "
+                         "to the bounded host tier; also skips the tier "
+                         "comparison pass")
+    ap.add_argument("--spill-dir", type=str, default=None,
+                    help="disk tier beneath the host KV tier: over-budget "
+                         "spilled prefixes serialize here (npz per page) "
+                         "instead of being dropped, and restore "
+                         "transparently on a hit")
     ap.add_argument("--request-rate", type=float, default=None,
                     help="Poisson arrival rate in req/s (default: offline)")
     ap.add_argument("--no-request-tracing", action="store_true",
@@ -585,6 +733,10 @@ def main():
     args = ap.parse_args()
     if args.request_rate is not None and args.request_rate <= 0:
         ap.error("--request-rate must be > 0")
+    if args.multi_turn < 1:
+        ap.error("--multi-turn must be >= 1")
+    if not 0.0 <= args.session_return_frac <= 1.0:
+        ap.error("--session-return-frac must be in [0, 1]")
     if args.tracing_reps < 1:
         ap.error("--tracing-reps must be >= 1")
     if args.spec_len < 0:
@@ -619,6 +771,9 @@ def main():
               shared_prefix_frac=args.shared_prefix_frac,
               oversubscribe=args.oversubscribe, preempt=args.preempt,
               mp=args.mp,
+              kv_tier=not args.no_kv_tier, spill_dir=args.spill_dir,
+              multi_turn=args.multi_turn,
+              session_return_frac=args.session_return_frac,
               request_tracing=not args.no_request_tracing,
               debug_bundle_dir=args.debug_bundle_dir)
     if on_tpu:
@@ -658,6 +813,26 @@ def main():
         stats["preemptions_per_step_delta"] = round(
             stats["preemptions_per_step"] - base["preemptions_per_step"], 4)
         stats["top1_agreement"] = round(agree / max(total, 1), 4)
+    if args.multi_turn > 1 and not args.no_kv_tier:
+        # tier on/off A/B on the SAME multi-turn stream: restores are
+        # bit-exact KV, so greedy outputs must match byte-for-byte
+        # (kv_tier_parity — session-keyed digest), and the capacity win is
+        # the returning-turn prefill the tier made unnecessary
+        # (returning_prefilled_drop) plus the TTFT a returning session no
+        # longer spends re-prefilling its conversation
+        base = run_serve_bench(spec_len=spec_len, fuse=fuse, **quant,
+                               **dict(kw, kv_tier=False))
+        stats["no_tier_prefilled_tokens"] = base["prefilled_tokens"]
+        stats["no_tier_returning_prefilled_tokens"] = \
+            base["returning_prefilled_tokens"]
+        stats["returning_prefilled_drop"] = round(
+            1.0 - stats["returning_prefilled_tokens"] /
+            max(base["returning_prefilled_tokens"], 1), 4)
+        stats["no_tier_returning_ttft_p50_ms"] = \
+            base["returning_ttft_p50_ms"]
+        stats["no_tier_ttft_p50_ms"] = base["ttft_p50_ms"]
+        stats["kv_tier_parity"] = \
+            stats["outputs_digest"] == base["outputs_digest"]
     if args.oversubscribe > 0:
         # unpressured comparison on the SAME stream at F=1 (pool capacity ==
         # submitted footprint, same slot count and machinery, no pressure):

@@ -795,18 +795,20 @@ def run_cost_checks(include_mp: bool = True, mp: int = 2,
         costs, fs = audit_resources(serving_targets(m, engines=(eng, leg)),
                                     at_rest, budget)
         findings.extend(fs)
-        # JXP009: the HOST swap pool (preempt="swap" KV parking) is sized,
-        # not traced — its declared ceiling is audited exactly, once per
-        # mesh pass (host memory does not shard: the bound is per host)
-        swap_cap = budget.get("swap_pool_bytes")
-        swap_bytes = eng.swap_pool_bytes()
-        if swap_cap is not None and swap_bytes > swap_cap:
+        # JXP009: the UNIFIED host pool (preempt="swap" victim parking +
+        # the kv_tier spilled-prefix store, one swap_pool_pages ceiling) is
+        # sized, not traced — its declared bound is audited exactly, once
+        # per mesh pass (host memory does not shard: the bound is per host)
+        host_cap = budget.get("host_pool_bytes")
+        host_bytes = eng.host_pool_bytes()
+        if host_cap is not None and host_bytes > host_cap:
             findings.append(Finding(
                 "JXP009", "<at-rest>", 0, 0,
-                f"host swap pool bound {swap_bytes} bytes exceeds the "
-                f"declared swap_pool_bytes budget {swap_cap} — size "
-                f"swap_pool_pages down or raise the budget with the host "
-                f"memory math that justifies it"))
+                f"unified host pool bound {host_bytes} bytes exceeds the "
+                f"declared host_pool_bytes budget {host_cap} — size "
+                f"swap_pool_pages down (it caps swap parking AND spilled "
+                f"prefix pages) or raise the budget with the host memory "
+                f"math that justifies it"))
         # ---- quantized serving pass (ISSUE-11): the int8 engine at the
         # SAME pool geometry, audited against its own declared yardstick —
         # the quantization win must show up here before any TPU run -------
@@ -846,20 +848,20 @@ def run_cost_checks(include_mp: bool = True, mp: int = 2,
                 f"({q_at_rest.param_bytes_replicated} vs fp "
                 f"{at_rest.param_bytes_replicated} bytes) — the quantized "
                 f"wte/head is not actually stored int8"))
-        q_swap_cap = budget.get("swap_pool_bytes_int8")
-        q_swap_bytes = qeng.swap_pool_bytes()
-        if q_swap_cap is not None and q_swap_bytes > q_swap_cap:
+        q_host_cap = budget.get("host_pool_bytes_int8")
+        q_host_bytes = qeng.host_pool_bytes()
+        if q_host_cap is not None and q_host_bytes > q_host_cap:
             findings.append(Finding(
                 "JXP009", "<at-rest>", 0, 0,
-                f"int8 host swap pool bound {q_swap_bytes} bytes exceeds "
-                f"the declared swap_pool_bytes_int8 budget {q_swap_cap} — "
-                f"int8 pages must swap as int8, not re-widened fp"))
+                f"int8 unified host pool bound {q_host_bytes} bytes exceeds "
+                f"the declared host_pool_bytes_int8 budget {q_host_cap} — "
+                f"int8 pages must park as int8, not re-widened fp"))
         reports[m] = {
             "at_rest": at_rest.to_json(),
             "at_rest_quantized": q_at_rest.to_json(),
             "quantized_pool_ratio": round(pool_ratio, 3),
-            "swap_pool_bytes": swap_bytes,
-            "swap_pool_bytes_int8": q_swap_bytes,
+            "host_pool_bytes": host_bytes,
+            "host_pool_bytes_int8": q_host_bytes,
             # predicted_ms computed HERE through ProgramCost.predicted_ms so
             # the CLI report and the bench JSON share one roofline formula
             "programs": [dict(c.to_json(),

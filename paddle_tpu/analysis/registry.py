@@ -40,11 +40,13 @@ from typing import Dict, List, Optional, Tuple
 # device.  The prefill budget covers the cold paths (bucketed one-shot +
 # prefix-tail chunk in bucketed mode; zero programs in chunked mode, where
 # the chunk rides the fused batch), plus one COW page copy.  The swap budget
-# (oversubscription PR) covers the two preemption KV-swap copies — ONE
-# fixed-shape gather (`swap_out_pages`, victim pages padded to the slot
-# capacity) and ONE scatter (`swap_in_pages`) — compiled only when
-# `preempt="swap"` actually preempts, so the default reservation-mode bench
-# measures 0 against this <= 2 bound (total 4 -> 6 is the documented bump).
+# covers the two KV-copy executables — ONE fixed-shape gather
+# (`swap_out_pages`, page ids padded to the slot capacity) and ONE scatter
+# (`swap_in_pages`) — shared by BOTH host-copy paths: preemption swap
+# parking (oversubscription PR) and the KV tier's prefix spill/restore
+# (tiering PR), which reuse the same programs so tiering adds ZERO
+# executables.  They compile only when a swap or spill actually fires
+# (warmed by `warm_swap` on engines that can reach them).
 SERVE_PROGRAM_BUDGET: Dict[str, int] = {
     "decode_side_executables": 1,   # THE fused serve_step_paged program
     "prefill_executables": 2,
@@ -127,13 +129,16 @@ SERVE_RESOURCE_BUDGET: Dict[str, object] = {
         "serve.mp2.bucketed_prefill": 24_576,
         "serve.mp2.verify": 20_480,
     },
-    # HOST-side swap pool ceiling (oversubscription PR): the bound
-    # `LLMEngine.swap_pool_bytes()` declares for preempt="swap" KV parking —
-    # audit engine: 8 pages x (2 layers x 8 tok x 4 KVH x 16 hd x 4 B x k+v)
-    # = 64 KiB, checked exactly (the host pool is sized, not traced).  The
-    # yardstick for the quantized-KV arc: halving page bytes must halve this
-    # ceiling too (JXP009).
-    "swap_pool_bytes": 65_536,
+    # UNIFIED host-pool ceiling (JXP009): the bound
+    # `LLMEngine.host_pool_bytes()` declares for EVERYTHING parked in host
+    # memory — preempt="swap" victim KV AND the kv_tier spilled-prefix store
+    # share this one `swap_pool_pages` budget (disk-tier pages are
+    # off-budget; intake admission and the preempt decision both count
+    # against it via `PagedKVCache.host_pool_room`).  Audit engine: 8 pages
+    # x (2 layers x 8 tok x 4 KVH x 16 hd x 4 B x k+v) = 64 KiB, checked
+    # exactly (the host pool is sized, not traced).  The yardstick for the
+    # quantized-KV arc: halving page bytes must halve this ceiling too.
+    "host_pool_bytes": 65_536,
     # ---- quantized serving (weight_dtype="int8" + kv_dtype="int8") --------
     # The quantized audit engine (same gpt_tiny(64) geometry, 9-page pool) is
     # accounted alongside the fp one each pass; all four numbers below are
@@ -153,10 +158,11 @@ SERVE_RESOURCE_BUDGET: Dict[str, object] = {
     #   a universal constant).
     "quantized_pool_bytes": 24_576,
     "quantized_pool_min_ratio": 2.0,
-    # - int8 host swap-pool ceiling (JXP009 extended): int8 pages swap as
-    #   int8 — 8 pages x 2.5 KiB/page (k+v int8 + scale lanes) = 20 KiB,
-    #   checked exactly like the fp bound (3.2x under the fp 64 KiB).
-    "swap_pool_bytes_int8": 20_480,
+    # - int8 unified host-pool ceiling (JXP009 extended): int8 pages park
+    #   as int8 — spill and swap alike — 8 pages x 2.5 KiB/page (k+v int8 +
+    #   scale lanes) = 20 KiB, checked exactly like the fp bound (3.2x
+    #   under the fp 64 KiB).
+    "host_pool_bytes_int8": 20_480,
 }
 
 
@@ -225,11 +231,12 @@ SERVE_SLO: Dict[str, object] = {
 # the bench (byte parity, dispatch counts, the stamp-count tracing account)
 # tightly and the wall-clock ratios loosely.
 SERVE_PERF_FLOORS: Dict[str, object] = {
-    "schema_version": 1,
+    "schema_version": 2,
     # every parity flag a bench run reports must be True — byte-exact greedy
-    # parity is the one bar noise cannot excuse
+    # parity is the one bar noise cannot excuse (kv_tier_parity: tier
+    # restores must be bit-exact vs the --no-kv-tier re-prefill)
     "parity_flags": ("fuse_parity", "spec_parity", "oversubscribe_parity",
-                     "tracing_parity"),
+                     "tracing_parity", "kv_tier_parity"),
     # the one-dispatch claim in numbers: a fused busy step dispatches
     # exactly ONE decode-side program — tied to the program budget above so
     # the two guards cannot drift apart
@@ -252,6 +259,12 @@ SERVE_PERF_FLOORS: Dict[str, object] = {
     "model_error_max": 1.0e5,
     # a bench run that emitted nothing has no trajectory row to contribute
     "tokens_per_sec_min": 1.0,
+    # the KV-tier capacity claim, deterministic on any multi-turn row that
+    # ran the --no-kv-tier comparison: returning sessions must re-prefill
+    # at most half the tokens the drop-on-evict baseline pays (the measured
+    # CPU smoke sits ~0.7-0.85; token counts are scheduling-exact, so this
+    # floor is noise-free)
+    "returning_prefilled_drop_min": 0.5,
 }
 
 
@@ -284,8 +297,9 @@ PROGRAM_SOURCES: Tuple[ProgramSource, ...] = (
              "one-dispatch step (decode + verify + interleaved chunk in one "
              "[B, max(K+1, chunk)] batch, on-device sampling/acceptance, "
              "O(B*K)-int host output) — plus the cold prefill paths, the "
-             "COW copy and the two preemption KV-swap copies (swap_out "
-             "gather / swap_in scatter, compiled only when preempt='swap' "
+             "COW copy and the two KV-swap copies (swap_out gather / "
+             "swap_in scatter — shared by preemption swap parking AND the "
+             "KV tier's prefix spill/restore, compiled when either path "
              "fires); fuse=False additionally builds the legacy decode/"
              "chunk/verify trio (A/B baseline, outside the default budget)"),
     # ---- model core -------------------------------------------------------

@@ -35,17 +35,41 @@ new slot owns before appending into it.  Pages are freed only when their
 refcount returns to 0; registered pages at refcount 0 park in an LRU of
 evictable prefixes and are reclaimed on demand, so cached prefixes can never
 deadlock the pool.
+
+Rolling-hash partial-page index: next to the page-granularity trie, every
+registered page also indexes the PREFIXES of its token content under a
+polynomial rolling hash, so a prompt sharing only a partial tail of a cached
+page (any page, not just one that happened to be registered as a partial
+node) COW-copies the matched fraction and prefills only the true remainder.
+Hash hits are verified against the node's stored token bytes before use, so
+a collision can never corrupt a match.
+
+KV tiering (device -> host -> optional disk): when a `HostKVTier` is
+attached (`attach_tier`), `_evict` no longer drops retired prefixes — their
+page CONTENT spills to a bounded host tier through the engine's spill
+callback (the PR-10 `swap_out_pages` gather, d2h overlapped with the next
+dispatch) and the trie node stays matchable with `page = HOST_PAGE`.  A
+later `allocate_prefixed` whose prefix lives off-device assigns fresh pages
+to those nodes and returns a restore plan (`take_restore`): the engine
+scatters the parked KV back with ONE `swap_in_pages` dispatch and
+`commit_restore` re-registers the nodes on device — a returning session's
+conversation KV restores with one h2d scatter instead of a full re-prefill.
+The host tier shares the engine's unified host-pool page budget with
+preemption swap parking (`host_pool_room`); over budget it cascades to a
+disk tier (`spill_dir=`) or drops, oldest first.
 """
 from __future__ import annotations
 
 import dataclasses
 import itertools
+import os
 from collections import OrderedDict
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
 NULL_PAGE = 0
+HOST_PAGE = -1      # node.page sentinel: content lives in the host/disk tier
 
 
 @dataclasses.dataclass
@@ -53,14 +77,150 @@ class _PrefixNode:
     """One cached page of prompt KV: `page` holds the KV of `n_tokens` tokens
     whose identity (and that of the whole preceding prefix) is pinned by
     `key = (parent node id, token bytes)`.  n_tokens == page_size for full
-    pages; a smaller n marks a partial page, shareable only via COW."""
+    pages; a smaller n marks a partial page, shareable only via COW.
+    page == HOST_PAGE marks a node whose KV content lives in the attached
+    `HostKVTier` (host numpy or disk) instead of a device page.
+    `partial_keys` are the rolling-hash partial-index entries this node
+    registered — removed with the node so the index cannot dangle."""
     node_id: int
     key: Tuple[int, bytes]
     page: int
     n_tokens: int
+    partial_keys: List[Tuple[int, int, int]] = \
+        dataclasses.field(default_factory=list)
 
 
 _ROOT = 0   # parent id of first-page nodes
+
+# polynomial rolling hash over int32 token ids (base/modulus pairing keeps
+# collisions rare; every hit is verified against the node's token bytes, so
+# hash quality affects only lookup cost, never correctness)
+_HASH_BASE = 1000003
+_HASH_MOD = (1 << 61) - 1
+
+# shortest partial-page tail worth matching: a 1-token hit costs a COW page
+# copy (and, in bucketed mode, the chunk-tail prefill path) to save one
+# token of prefill — and at small vocabularies single-token prefixes of
+# unrelated prompts coincide often enough (~#root-children/vocab per
+# admission) to tax the dispatch account with worthless hits
+_MIN_PARTIAL = 2
+
+
+class HostKVTier:
+    """Bounded host-side storage for spilled prefix-page KV, with an optional
+    disk level underneath (`spill_dir`).
+
+    Pure storage + LRU ordering: entries are keyed by prefix-node id and hold
+    either host numpy page slabs ({lane name: [L, page_size, ...]}), a
+    PENDING marker (the engine gathered the page on device but the d2h fetch
+    is still deferred past the next dispatch), or a disk path.  Budget policy
+    lives in the owner: `PagedKVCache.tier_make_room` pushes LRU host entries
+    down to disk (or drops them) and the ENGINE decides how many pages of the
+    unified host pool the tier may hold (`LLMEngine.swap_pool_pages` shared
+    with preemption swap parking)."""
+
+    _PENDING = object()
+
+    def __init__(self, spill_dir: Optional[str] = None,
+                 disk_pages: Optional[int] = None):
+        self._host: "OrderedDict[int, object]" = OrderedDict()
+        self._disk: "OrderedDict[int, str]" = OrderedDict()
+        self.spill_dir = spill_dir
+        self.disk_pages = disk_pages
+        if spill_dir is not None:
+            os.makedirs(spill_dir, exist_ok=True)
+        # monotonic event counts (the engine mirrors the user-facing ones
+        # into its MetricsRegistry; these back the invariant checks)
+        self.disk_spills = 0
+        self.disk_restores = 0
+        self.tier_drops = 0
+
+    # ---- occupancy --------------------------------------------------------
+    @property
+    def pages_host(self) -> int:
+        """Host-resident pages, PENDING gathers included (they count against
+        the unified host-pool budget: their bytes are committed)."""
+        return len(self._host)
+
+    @property
+    def pages_disk(self) -> int:
+        return len(self._disk)
+
+    def has(self, node_id: int) -> bool:
+        return node_id in self._host or node_id in self._disk
+
+    def is_pending(self, node_id: int) -> bool:
+        return self._host.get(node_id) is self._PENDING
+
+    # ---- spill / fill -----------------------------------------------------
+    def add_pending(self, node_id: int) -> None:
+        """Reserve a host entry for a page whose device gather is in flight
+        (the engine fills it at the next `_pending_d2h` drain)."""
+        if self.has(node_id):
+            raise RuntimeError(f"tier node {node_id} already present")
+        self._host[node_id] = self._PENDING
+
+    def fill(self, node_id: int, data: Dict[str, np.ndarray]) -> None:
+        """Land a pending entry's fetched page content."""
+        if self._host.get(node_id) is not self._PENDING:
+            raise RuntimeError(f"tier node {node_id} is not pending")
+        self._host[node_id] = data
+
+    # ---- read / restore ---------------------------------------------------
+    def data(self, node_id: int) -> Dict[str, np.ndarray]:
+        """The node's page content (host copy; read through from disk when
+        it cascaded there — the entry STAYS at its level, so a read can
+        never push the host level over its budget).  Raises KeyError when
+        the node is unknown and RuntimeError while its d2h fetch is still
+        pending (the engine drains pending gathers before restoring)."""
+        if node_id in self._host:
+            e = self._host[node_id]
+            if e is self._PENDING:
+                raise RuntimeError(f"tier node {node_id} still pending d2h")
+            self._host.move_to_end(node_id)
+            return e
+        path = self._disk[node_id]
+        with np.load(path) as z:
+            data = {name: z[name] for name in z.files}
+        self.disk_restores += 1
+        return data
+
+    def pop(self, node_id: int) -> None:
+        """Remove an entry whose page moved back to the device tier."""
+        if self._host.pop(node_id, None) is None:
+            path = self._disk.pop(node_id)
+            os.remove(path)
+
+    def drop(self, node_id: int) -> None:
+        """Discard an entry (node dropped from the index): host bytes and/or
+        disk file released."""
+        self._host.pop(node_id, None)
+        path = self._disk.pop(node_id, None)
+        if path is not None and os.path.exists(path):
+            os.remove(path)
+        self.tier_drops += 1
+
+    # ---- host -> disk cascade ---------------------------------------------
+    def demotable(self) -> List[int]:
+        """Host node ids oldest-first, pending entries excluded (their bytes
+        do not exist on host yet, so they can neither demote nor drop)."""
+        return [nid for nid, e in self._host.items()
+                if e is not self._PENDING]
+
+    def to_disk(self, node_id: int) -> bool:
+        """Demote one host entry to the disk level; False when no spill_dir
+        is configured (the caller drops the node instead)."""
+        if self.spill_dir is None:
+            return False
+        data = self._host[node_id]
+        if data is self._PENDING:
+            raise RuntimeError(f"cannot demote pending tier node {node_id}")
+        path = os.path.join(self.spill_dir, f"kvnode_{node_id}.npz")
+        np.savez(path, **data)
+        del self._host[node_id]
+        self._disk[node_id] = path
+        self.disk_spills += 1
+        return True
 
 
 class PagedKVCache:
@@ -84,13 +244,25 @@ class PagedKVCache:
         self.lengths = np.zeros((num_slots,), np.int32)
         self._used: Dict[int, List[int]] = {s: [] for s in range(num_slots)}
         self._ref = np.zeros((num_pages,), np.int64)
-        # prefix index: key -> node; page -> node; LRU of refcount-0 nodes
+        # prefix index: key -> node; page -> node (device nodes only); LRU of
+        # refcount-0 device nodes; rolling-hash partial index
+        # (parent, j, hash) -> node over every registered page's j-token
+        # content prefixes
         self._index: Dict[Tuple[int, bytes], _PrefixNode] = {}
         self._page_node: Dict[int, _PrefixNode] = {}
         self._lru: "OrderedDict[int, _PrefixNode]" = OrderedDict()
+        self._partial: Dict[Tuple[int, int, int], _PrefixNode] = {}
         self._node_ids = itertools.count(1)
         self.prefix_evictions = 0
         self._evictions_counter = None      # metrics mirror, see attach_metrics
+        # KV tier (attach_tier): spilled-prefix storage + the engine's spill
+        # callback; _restore_plan[slot] is the off-device part of the latest
+        # allocate_prefixed match, consumed by the engine via take_restore
+        self._tier: Optional[HostKVTier] = None
+        self._spill_cb: Optional[
+            Callable[[List[_PrefixNode]], Set[int]]] = None
+        self._tier_nodes: Dict[int, _PrefixNode] = {}   # off-device nodes
+        self._restore_plan: Dict[int, List[Tuple[int, _PrefixNode, int]]] = {}
         # fourth partition: pages whose KV content lives in the HOST swap
         # pool, keyed by request id (the device pages themselves were
         # released — this tracks the off-device obligation so drain checks
@@ -123,11 +295,15 @@ class PagedKVCache:
         in_lru = 0
         if tokens is not None:
             full, partial = self._match(np.asarray(tokens, np.int32))
-            fresh = n - len(full)
-            for node in full:
+            # only DEVICE nodes share their page; off-device (tier) nodes
+            # restore into fresh pages, so they reduce nothing here
+            device_full = [nd for nd in full if nd.page >= 0]
+            fresh = n - len(device_full)
+            for node in device_full:
                 if self._ref[node.page] == 0:
                     in_lru += 1         # shared, so not evictable for us
-            if partial is not None and self._ref[partial.page] == 0:
+            if partial is not None and partial[0].page >= 0 and \
+                    self._ref[partial[0].page] == 0:
                 in_lru += 1             # COW source must survive the copy
         return fresh <= len(self._free) + len(self._lru) - in_lru
 
@@ -155,19 +331,89 @@ class PagedKVCache:
         """Requests currently parked in the host swap pool."""
         return len(self._swapped)
 
+    @property
+    def tier_pages_host(self) -> int:
+        """Spilled prefix pages resident on host (pending gathers included);
+        0 with no tier attached."""
+        return 0 if self._tier is None else self._tier.pages_host
+
+    @property
+    def tier_pages_disk(self) -> int:
+        return 0 if self._tier is None else self._tier.pages_disk
+
     def host_pool_room(self, budget_pages: int) -> int:
-        """Pages of host swap-pool room left under `budget_pages`: the
-        budget minus the parked KV already counted against it.  The
-        PREEMPTION decision reads this number (can the victim park *now*,
-        given what is already parked) so the parked-KV account cannot be
-        double-spent.  Intake admission deliberately does NOT — it compares
-        the request's worst case against the raw budget (could it EVER
-        park, even in an empty pool), because a transiently full pool must
-        queue-and-drain, not reject (see `LLMEngine.add_request`).  Page
-        counts are
+        """Pages of host-pool room left under `budget_pages`: the budget
+        minus everything already counted against the UNIFIED host pool —
+        preemption swap parking AND spilled-prefix tier pages (disk pages
+        are off-budget).  The PREEMPTION decision reads this number (can
+        the victim park *now*, given what is already parked) so the
+        parked-KV account cannot be double-spent; it may first reclaim tier
+        room (`tier_make_room` — live victims outrank cached prefixes).
+        Intake admission deliberately does NOT — it compares the request's
+        worst case against the raw budget (could it EVER park, even in an
+        empty pool: parked victims drain and tier pages are droppable on
+        demand), because a transiently full pool must queue-and-drain, not
+        reject (see `LLMEngine.add_request`).  Page counts are
         dtype-oblivious: an int8 pool parks the same page count in ~2-4x
-        fewer host bytes (`LLMEngine.swap_pool_bytes`)."""
-        return budget_pages - self.swapped_page_count
+        fewer host bytes (`LLMEngine.host_pool_bytes`)."""
+        return budget_pages - self.swapped_page_count - self.tier_pages_host
+
+    def attach_tier(self, tier: HostKVTier,
+                    spill_cb: Callable[[List[_PrefixNode]], Set[int]]
+                    ) -> None:
+        """Enable KV tiering: `_evict` offers every retired prefix node to
+        `spill_cb` (the engine's batched device gather) instead of dropping
+        it; nodes the callback accepts (returned id set) stay in the index
+        with their content parked in `tier`."""
+        self._tier = tier
+        self._spill_cb = spill_cb
+
+    def tier_make_room(self, n_pages: int) -> int:
+        """Reclaim up to `n_pages` of HOST-tier room for the unified host
+        pool: LRU host entries demote to the disk level (when `spill_dir`
+        is configured) or are dropped from the index outright.  Pending
+        gathers cannot move.  Returns the pages actually freed — the
+        preemption path calls this before parking a victim, so live work
+        always outranks cached prefixes."""
+        if self._tier is None or n_pages <= 0:
+            return 0
+        freed = 0
+        for nid in self._tier.demotable():
+            if freed >= n_pages:
+                break
+            node = self._node_by_id(nid)
+            if self._tier.to_disk(nid):
+                self._enforce_disk_cap()
+            else:
+                self._drop_node(node)
+            freed += 1
+        return freed
+
+    def _enforce_disk_cap(self) -> None:
+        if self._tier is None or self._tier.disk_pages is None:
+            return
+        while self._tier.pages_disk > self._tier.disk_pages:
+            nid = next(iter(self._tier._disk))
+            self._drop_node(self._node_by_id(nid))
+
+    def _node_by_id(self, node_id: int) -> _PrefixNode:
+        return self._tier_nodes[node_id]
+
+    def tier_data(self, node: _PrefixNode) -> Dict[str, np.ndarray]:
+        """The parked page content of an off-device node (loads from disk
+        when it cascaded there).  KeyError/RuntimeError propagate — the
+        engine degrades the restore to re-prefill."""
+        if self._tier is None:
+            raise KeyError(f"no tier attached (node {node.node_id})")
+        return self._tier.data(node.node_id)
+
+    def drop_tier_nodes(self, nodes: List[_PrefixNode]) -> None:
+        """Drop off-device nodes entirely (failed d2h/h2d copy, vanished
+        data): index + partial entries + tier bytes all released — the
+        degrade path re-prefills instead."""
+        for node in nodes:
+            if self._index.get(node.key) is node:
+                self._drop_node(node)
 
     def pool_pressure(self) -> float:
         """Fraction of the real pool in live use (0.0 idle .. 1.0 full) —
@@ -193,6 +439,10 @@ class PagedKVCache:
                        "pages registered in the prefix index")
         registry.gauge("kv_pages_swapped", lambda: self.swapped_page_count,
                        "pages whose KV lives in the host swap pool")
+        registry.gauge("kv_tier_pages_host", lambda: self.tier_pages_host,
+                       "spilled prefix pages resident in the host KV tier")
+        registry.gauge("kv_tier_pages_disk", lambda: self.tier_pages_disk,
+                       "spilled prefix pages serialized to the disk tier")
         # ratio gauge: a fleet merge folds it by MAX (a sum of per-replica
         # fractions would read >100% on a healthy fleet; the router's signal
         # is the worst member)
@@ -201,10 +451,17 @@ class PagedKVCache:
 
     # ---- prefix index -----------------------------------------------------
     def _match(self, tokens: np.ndarray
-               ) -> Tuple[List[_PrefixNode], Optional[_PrefixNode]]:
+               ) -> Tuple[List[_PrefixNode],
+                          Optional[Tuple[_PrefixNode, int]]]:
         """Longest cached prefix of `tokens`, capped at len(tokens) - 1 so at
         least one position is always recomputed (its logits seed generation).
-        Returns (full-page nodes, optional partial-page node extending them)."""
+        Returns (full-page nodes, optional (partial node, matched tokens)
+        extending them).  Full nodes may live off-device (page == HOST_PAGE)
+        when a tier is attached — the caller restores them.  The partial
+        match runs over the rolling-hash index: ANY registered page whose
+        content starts with the prompt's tail yields a COW hit, not just a
+        page registered under that exact partial content (the PR-2
+        behavior this subsumes)."""
         page = self.page_size
         lp = tokens.size
         full: List[_PrefixNode] = []
@@ -218,12 +475,48 @@ class PagedKVCache:
             parent = node.node_id
         base = len(full) * page
         partial = None
-        for j in range(min(lp - base - 1, page - 1), 0, -1):
-            node = self._index.get((parent, tokens[base:base + j].tobytes()))
-            if node is not None:
-                partial = node
-                break
+        h = 0
+        for j in range(1, min(lp - base - 1, page - 1) + 1):
+            h = (h * _HASH_BASE + int(tokens[base + j - 1]) + 1) % _HASH_MOD
+            if j < _MIN_PARTIAL:
+                continue
+            node = self._partial.get((parent, j, h))
+            if node is not None and \
+                    node.key[1][:4 * j] == tokens[base:base + j].tobytes():
+                partial = (node, j)     # longest verified hit wins
         return full, partial
+
+    def _register_partial(self, node: _PrefixNode) -> None:
+        """Index every proper prefix of `node`'s token content under the
+        rolling hash (first registrant wins a colliding key — equal content
+        hashes equally, so the match outcome is unaffected)."""
+        toks = np.frombuffer(node.key[1], np.int32)
+        cap = node.n_tokens if node.n_tokens < self.page_size \
+            else self.page_size - 1
+        h = 0
+        parent = node.key[0]
+        for j in range(1, cap + 1):
+            h = (h * _HASH_BASE + int(toks[j - 1]) + 1) % _HASH_MOD
+            if j < _MIN_PARTIAL:
+                continue
+            k = (parent, j, h)
+            if k not in self._partial:
+                self._partial[k] = node
+                node.partial_keys.append(k)
+
+    def _drop_node(self, node: _PrefixNode) -> None:
+        """Remove a node from every index structure (its page, if any, is
+        NOT touched — callers manage the free list)."""
+        del self._index[node.key]
+        for k in node.partial_keys:
+            if self._partial.get(k) is node:
+                del self._partial[k]
+        node.partial_keys = []
+        if node.page >= 0:
+            self._page_node.pop(node.page, None)
+        elif self._tier is not None:
+            self._tier_nodes.pop(node.node_id, None)
+            self._tier.drop(node.node_id)
 
     def register_prefix(self, slot: int, tokens: np.ndarray,
                         filled: int) -> None:
@@ -247,6 +540,7 @@ class PagedKVCache:
                 node = _PrefixNode(next(self._node_ids), key, pages[i], page)
                 self._index[key] = node
                 self._page_node[pages[i]] = node
+                self._register_partial(node)
             if node is None:        # page already published under another key
                 return
             parent = node.node_id
@@ -258,18 +552,39 @@ class PagedKVCache:
                 node = _PrefixNode(next(self._node_ids), key, pages[i], rem)
                 self._index[key] = node
                 self._page_node[pages[i]] = node
+                self._register_partial(node)
 
     def _evict(self, fresh_needed: int) -> None:
-        """Reclaim LRU unreferenced cached prefixes until `fresh_needed` pages
-        are on the free list (or the LRU runs dry)."""
+        """Reclaim LRU unreferenced cached prefixes until `fresh_needed`
+        pages are on the free list (or the LRU runs dry).  With a tier
+        attached, evicted nodes are offered to the engine's spill callback
+        in ONE batch (one fixed-shape `swap_out_pages` gather per
+        `max_pages_per_slot` pages, d2h deferred): accepted nodes keep their
+        index entry with `page = HOST_PAGE`; the rest drop as before.  The
+        page returns to the free list either way — the gather dispatch is
+        ordered before any dispatch that could overwrite the page, so its
+        content is safe to fetch later."""
+        evicted: List[_PrefixNode] = []
         while len(self._free) < fresh_needed and self._lru:
             _, node = self._lru.popitem(last=False)
-            del self._index[node.key]
-            del self._page_node[node.page]
+            evicted.append(node)
             self._free.append(node.page)
             self.prefix_evictions += 1
             if self._evictions_counter is not None:
                 self._evictions_counter.inc()
+        if not evicted:
+            return
+        accepted: Set[int] = set()
+        if self._spill_cb is not None:
+            accepted = self._spill_cb(evicted)
+        for node in evicted:
+            if node.node_id in accepted:
+                del self._page_node[node.page]
+                node.page = HOST_PAGE
+                self._tier_nodes[node.node_id] = node
+                self._tier.add_pending(node.node_id)
+            else:
+                self._drop_node(node)
 
     # ---- slot lifecycle ---------------------------------------------------
     def allocate(self, slot: int, total_tokens: int) -> np.ndarray:
@@ -286,11 +601,20 @@ class PagedKVCache:
 
         Returns (table row view, matched_tokens, cow):
         - matched_tokens: prompt tokens whose KV the slot starts with —
-          full shared pages (mapped read-only, refcount++) plus, when `cow`
-          is set, the tokens of a matched partial page;
+          full shared pages (mapped read-only, refcount++), full pages
+          restored from the KV tier (fresh pages the engine scatters the
+          parked content into — see `take_restore`) plus, when `cow` is set
+          or a tier partial matched, the matched tokens of a partial page;
         - cow: (src_page, dst_page) the CALLER must copy on device before the
           slot writes anything — dst is the slot's own fresh page at the
-          partial boundary, src a cached page it must not mutate.
+          partial boundary, src a cached DEVICE page it must not mutate (an
+          off-device partial source rides the restore plan instead: the
+          scatter IS the copy).
+
+        When the match includes off-device nodes the engine MUST consume the
+        restore plan (`take_restore(slot)`) and either scatter +
+        `commit_restore` or roll the slot back (`release`) — `matched`
+        already counts the planned tokens.
         """
         n = self.pages_needed(total_tokens)
         if n > self.max_pages_per_slot:
@@ -303,23 +627,34 @@ class PagedKVCache:
         partial = None
         if tokens is not None:
             full, partial = self._match(np.asarray(tokens, np.int32))
-        shared = []
+        shared = []                     # device pages shared (for rollback)
         for node in full:
+            if node.page < 0:
+                continue                # off-device: restored, not shared
             if self._ref[node.page] == 0:
                 self._lru.pop(node.node_id, None)   # revive from evictable
             self._ref[node.page] += 1
             shared.append(node.page)
+        pnode, pmatch = partial if partial is not None else (None, 0)
         # pin the COW source for the duration of this allocation: it must not
         # be evicted to satisfy our own fresh-page demand
-        if partial is not None and partial.node_id in self._lru:
-            self._lru.move_to_end(partial.node_id)
-            pinned = self._lru.pop(partial.node_id)
+        if pnode is not None and pnode.node_id in self._lru:
+            self._lru.move_to_end(pnode.node_id)
+            pinned = self._lru.pop(pnode.node_id)
         else:
             pinned = None
         fresh_needed = n - len(shared)
         self._evict(fresh_needed)
         if pinned is not None:
             self._lru[pinned.node_id] = pinned
+        if fresh_needed > len(self._free) and pnode is not None:
+            # the partial hit is a luxury the pool cannot afford: its pinned
+            # COW source may be the very page this allocation needs (a
+            # full-footprint request would otherwise wait forever on an
+            # idle engine).  Drop the partial match — the source returns to
+            # the LRU, evictable like any other parked page — and retry.
+            pnode, pmatch = None, 0
+            self._evict(fresh_needed)
         if fresh_needed > len(self._free):
             for p in reversed(shared):              # roll back the sharing
                 self._ref[p] -= 1
@@ -331,16 +666,63 @@ class PagedKVCache:
         fresh = [self._free.pop() for _ in range(fresh_needed)]
         for p in fresh:
             self._ref[p] = 1
-        pages = shared + fresh
+        # lay the row out chain-position-accurately: device nodes keep their
+        # shared page at their prefix position, off-device nodes take the
+        # next fresh page (the engine scatters their parked KV into it), and
+        # the remaining fresh pages fill the tail
+        pages: List[int] = []
+        plan: List[Tuple[int, _PrefixNode, int]] = []
+        fi = 0
+        for node in full:
+            if node.page >= 0:
+                pages.append(node.page)
+            else:
+                pages.append(fresh[fi])
+                plan.append((fresh[fi], node, self.page_size))
+                fi += 1
+        boundary = len(pages)
+        pages.extend(fresh[fi:])
         self._used[slot] = pages
         self.page_table[slot, :] = NULL_PAGE
         self.page_table[slot, :n] = pages
-        matched = len(shared) * self.page_size
+        matched = boundary * self.page_size
         cow = None
-        if partial is not None:
-            cow = (partial.page, fresh[0])
-            matched += partial.n_tokens
+        if pnode is not None:
+            if pnode.page >= 0:
+                cow = (pnode.page, pages[boundary])
+            else:
+                # off-device partial: the restore scatter into the slot's own
+                # boundary page IS the copy; the node stays in the tier (the
+                # slot appends past the matched fraction, so the page cannot
+                # re-register under the node)
+                plan.append((pages[boundary], pnode, pmatch))
+            matched += pmatch
+        if plan:
+            self._restore_plan[slot] = plan
         return self.page_table[slot], matched, cow
+
+    def take_restore(self, slot: int
+                     ) -> List[Tuple[int, _PrefixNode, int]]:
+        """Pop the off-device part of `slot`'s latest `allocate_prefixed`
+        match: [(dst_page, node, n_tokens)] the engine must scatter from the
+        tier into the slot's fresh pages (ONE `swap_in_pages` dispatch)
+        before the slot computes anything.  Empty when the match was
+        all-device."""
+        return self._restore_plan.pop(slot, [])
+
+    def commit_restore(self, slot: int,
+                       plan: List[Tuple[int, _PrefixNode, int]]) -> None:
+        """The restore scatter landed: full-page nodes move back to the
+        device tier (their fresh page now holds their exact content, so
+        they are matchable/shareable/re-spillable like any registered
+        page); a partial node stays in the tier — the slot appends past the
+        matched fraction, so its page diverges from the node content."""
+        for dst, node, ntok in plan:
+            if ntok == self.page_size and node.n_tokens == self.page_size:
+                node.page = dst
+                self._page_node[dst] = node
+                self._tier_nodes.pop(node.node_id, None)
+                self._tier.pop(node.node_id)
 
     def grow(self, slot: int, total_tokens: int) -> None:
         """Optimistic admission's token-granular growth: extend `slot`'s
@@ -438,6 +820,7 @@ class PagedKVCache:
             "O(1) pages_in_use diverged from the refcount scan"
         for node in self._lru.values():
             assert self._index.get(node.key) is node, "LRU node unregistered"
+            assert node.page >= 0, "off-device node parked in the device LRU"
         for page, node in self._page_node.items():
             assert node.page == page
         # fourth (host-side) partition: every swap-pool obligation is a
@@ -447,10 +830,44 @@ class PagedKVCache:
         for rid, n in self._swapped.items():
             assert 0 < n <= self.max_pages_per_slot, \
                 f"swapped request {rid} records {n} pages"
+        # fifth (tier) partition: every indexed node is EITHER a device node
+        # (page mapped in _page_node) or an off-device node whose content the
+        # tier tracks (host, pending, or disk) — and vice versa, the tier
+        # holds no entry the index forgot (a dropped node whose tier bytes
+        # survive is a host-memory leak)
+        off_device = 0
+        for node in self._index.values():
+            if node.page >= 0:
+                assert self._page_node.get(node.page) is node, \
+                    f"device node {node.node_id} not in the page map"
+            else:
+                off_device += 1
+                assert self._tier is not None and \
+                    self._tier.has(node.node_id), \
+                    f"off-device node {node.node_id} has no tier entry"
+                assert self._tier_nodes.get(node.node_id) is node, \
+                    f"off-device node {node.node_id} missing from _tier_nodes"
+        if self._tier is not None:
+            assert off_device == self._tier.pages_host + \
+                self._tier.pages_disk, \
+                (f"tier holds {self._tier.pages_host}+"
+                 f"{self._tier.pages_disk} pages but the index has "
+                 f"{off_device} off-device nodes")
+            assert len(self._tier_nodes) == off_device
+        else:
+            assert off_device == 0, "off-device node with no tier attached"
+        for k, node in self._partial.items():
+            assert self._index.get(node.key) is node, \
+                f"partial-index entry {k} points at an unregistered node"
+            assert k in node.partial_keys
+        assert not self._restore_plan, \
+            f"unconsumed restore plans for slots {list(self._restore_plan)}"
 
     def prefix_stats(self) -> Dict[str, int]:
         return {
             "cached_pages": len(self._index),
             "evictable_pages": len(self._lru),
             "prefix_evictions": self.prefix_evictions,
+            "tier_pages_host": self.tier_pages_host,
+            "tier_pages_disk": self.tier_pages_disk,
         }

@@ -154,6 +154,27 @@ and Orca's iteration-level scheduling (Yu et al., OSDI 2022), under the same
   forces pool pressure / failing swap copies / clock skew so tests can drive
   every preempt interleaving deterministically.
 
+- **KV tiering** (ROADMAP item 3: the swap pool generalized from a
+  preemption escape hatch into a capacity tier; the serving-side analogue of
+  the reference's save/load_inference_model persistence path) — with
+  `kv_tier=True` (default), prefix-cache pages evicted under pool pressure
+  spill device -> host instead of being dropped: `PagedKVCache._evict`
+  routes them through the SAME fixed-shape `swap_out_pages` gather the
+  preemption swap uses (d2h overlapped with the next dispatch via
+  `_pending_d2h`), parking the content in a `HostKVTier` under the UNIFIED
+  host-pool budget (`swap_pool_pages`, JXP009) shared with swap parking —
+  and admission maps a prefix hit from ANY tier: a later request whose
+  prefix lives on host (a returning chat session re-submitting its
+  conversation) restores it with ONE `swap_in_pages` scatter, collapsing
+  TTFT from O(context) prefill to one h2d + scatter.  Over-budget tier
+  content cascades to a disk level (`spill_dir=`) or drops, oldest first;
+  failed copies degrade spill -> drop and restore -> re-prefill with zero
+  leaked pages.  The prefix index itself is upgraded to a ROLLING-HASH
+  partial-page index: a prompt sharing only a partial tail of any cached
+  page COW-copies (or tier-scatters) the matched fraction and prefills only
+  the true remainder.  Zero new executables: spill/restore reuse the two
+  swap programs.
+
 `bench_serve.py` replays a Poisson request stream through this engine and
 reports decode tokens/s/chip, TTFT percentiles, prefix-cache hit rate,
 accepted tokens per verify step, compiled-program counts and — under
@@ -448,6 +469,15 @@ class LLMEngine:
     pressure / swap-copy failures / clock skew (tests only; see
     `inference.faults.FaultPlan`).
 
+    KV tiering: `kv_tier=True` (default; needs the prefix cache and a
+    positive `swap_pool_pages`) spills LRU-evicted prefix pages to a host
+    tier under the unified host-pool budget instead of dropping them, and
+    admission restores a matched prefix from host (or the optional
+    `spill_dir=` disk level) with one `swap_in_pages` scatter — a
+    returning session skips its re-prefill entirely.  `kv_tier=False`
+    restores the PR-10 drop-on-evict behavior (`bench_serve.py
+    --no-kv-tier`).
+
     Quantized serving: `weight_dtype="int8"` PTQ-quantizes the serving
     matmul weights once at init (symmetric per-channel,
     `quantization.serving.quantize_serving_params`; dequant rides per block
@@ -491,6 +521,9 @@ class LLMEngine:
                  admission: str = "reservation",
                  preempt: str = "recompute",
                  swap_pool_pages: Optional[int] = None,
+                 kv_tier: bool = True,
+                 spill_dir: Optional[str] = None,
+                 spill_disk_pages: Optional[int] = None,
                  fault_plan: Optional[FaultPlan] = None,
                  weight_dtype: Optional[str] = None,
                  kv_dtype: Optional[str] = None,
@@ -635,14 +668,29 @@ class LLMEngine:
         self._faults = fault_plan or FaultPlan()
         self.cache = PagedKVCache(num_pages, page_size, num_slots,
                                   max_pages_per_slot)
-        # host swap pool bound, in pages (preempt="swap" parks victim KV
-        # here): default mirrors the device pool — the host obligation can
-        # never exceed what the device could hold
+        # UNIFIED host pool bound, in pages: preempt="swap" victim parking
+        # AND the kv_tier spilled-prefix store share this one ceiling (the
+        # JXP009 budget).  Default mirrors the device pool — the host
+        # obligation can never exceed what the device could hold
         self.swap_pool_pages = (num_pages - 1) if swap_pool_pages is None \
             else int(swap_pool_pages)
         if self.swap_pool_pages < 0:
             raise ValueError(
                 f"swap_pool_pages must be >= 0, got {swap_pool_pages}")
+        # KV tiering (ROADMAP item 3): retired prefix-cache pages spill
+        # device -> host (-> optional disk via spill_dir) instead of being
+        # LRU-dropped, and admission restores a prefix hit from ANY tier
+        # with one swap_in_pages scatter — no prefill replay.  Needs the
+        # prefix index (the trie keys the tier) and host-pool room.
+        self.kv_tier = bool(kv_tier) and prefix_cache and \
+            self.swap_pool_pages > 0
+        self.spill_dir = spill_dir if self.kv_tier else None
+        if self.kv_tier:
+            from .cache import HostKVTier
+            self.cache.attach_tier(
+                HostKVTier(spill_dir=self.spill_dir,
+                           disk_pages=spill_disk_pages),
+                self._spill_prefix_nodes)
         # optimistic-admission watermark: global free-page headroom kept back
         # at admission (vLLM's watermark_blocks), ~1% of the pool
         self._watermark = max(1, (self.cache.num_pages - 1) // 100)
@@ -730,6 +778,25 @@ class LLMEngine:
             "intake_swap_rejects",
             "intake rejections because the worst-case footprint exceeds the "
             "host swap pool (the request could never be parked)")
+        # KV-tier surface: spill/restore traffic between the device prefix
+        # cache and the host (+disk) tier, plus the rolling-hash partial-
+        # page index's hit counter
+        self._tier_spills = m.counter(
+            "kv_tier_spills",
+            "evicted prefix pages delivered to the host KV tier (counted "
+            "at d2h success, like swapped_pages)")
+        self._tier_restores = m.counter(
+            "kv_tier_restores",
+            "tier restore scatters (one per admission resuming >= 1 page "
+            "from the host/disk tier)")
+        self._tier_restored_tokens = m.counter(
+            "kv_tier_restored_tokens",
+            "prompt tokens restored from the KV tier instead of re-prefilled")
+        self._partial_hits = m.counter(
+            "partial_page_hits",
+            "admissions whose prefix match ended inside a cached page "
+            "(rolling-hash partial index: COW copy or tier scatter of the "
+            "matched fraction)")
         # SLO accounting (deadline attainment + per-priority-class goodput):
         # attainment's denominator is EVERY retired deadline-bearing request
         # (timeouts and aborts count as misses there), while the latency
@@ -1030,6 +1097,13 @@ class LLMEngine:
           shapes, not of any run."""
         self.metrics.reset()
         self.cache.prefix_evictions = 0
+        if self.cache._tier is not None:
+            # the tier's own event mirrors zero with the registry counters
+            # (its CONTENT — parked pages — is cache state and survives,
+            # like the prefix index itself)
+            self.cache._tier.disk_spills = 0
+            self.cache._tier.disk_restores = 0
+            self.cache._tier.tier_drops = 0
         getattr(self.proposer, "reset_stats", lambda: None)()
         self._step_idx = 0
         self._step_trace.clear()
@@ -1682,8 +1756,15 @@ class LLMEngine:
         }
         L = int(mgr.lengths[slot])
         n = mgr.pages_needed(L)
-        if self.preempt == "swap" and \
-                n <= mgr.host_pool_room(self.swap_pool_pages):
+        if self.preempt == "swap":
+            # live victims outrank cached prefixes in the unified host pool:
+            # reclaim tier room (demote to disk or drop) before giving up
+            room = mgr.host_pool_room(self.swap_pool_pages)
+            if n > room:
+                room += mgr.tier_make_room(n - room)
+        else:
+            room = -1
+        if self.preempt == "swap" and n <= room:
             # gather the victim's pages into a standalone buffer NOW (the
             # pages are about to be handed to a new owner); the blocking
             # d2h fetch is deferred until after the next dispatch
@@ -1743,12 +1824,141 @@ class LLMEngine:
         schedule."""
         while self._pending_d2h:
             rec = self._pending_d2h.pop()
+            if rec["kind"] == "spill":
+                if not rec.get("fetched"):
+                    try:
+                        self._materialize_spill(rec)
+                    except FaultInjected:
+                        self._degrade_spill_to_drop(rec)
+                continue
             if rec["kind"] != "swap" or rec.get("fetched"):
                 continue            # consumed, degraded or dropped already
             try:
                 self._materialize_swap(rec)
             except FaultInjected:
                 self._degrade_to_recompute(rec)
+
+    # ---- KV tiering: prefix spill (device -> host -> disk) and restore ----
+    def _spill_prefix_nodes(self, nodes) -> set:
+        """`PagedKVCache._evict`'s spill callback: gather the evicted
+        prefix pages into standalone device buffers (the PR-10
+        `swap_out_pages` executable, one fixed-shape dispatch per
+        `max_pages_per_slot` pages) and defer the blocking d2h fetch past
+        the next dispatch (`_pending_d2h`), exactly the preemption-swap
+        discipline.  Room comes from the UNIFIED host pool: what swap
+        parking has not claimed, reclaiming host-tier room downward (disk
+        or drop) first.  Returns the node ids accepted — the cache drops
+        the rest."""
+        mgr = self.cache
+        room = mgr.host_pool_room(self.swap_pool_pages)
+        if room < len(nodes):
+            room += mgr.tier_make_room(len(nodes) - room)
+        if room <= 0:
+            return set()
+        accept = nodes[-room:] if room < len(nodes) else nodes
+        P = mgr.max_pages_per_slot
+        for i in range(0, len(accept), P):
+            chunk = accept[i:i + P]
+            ids = np.zeros((P,), np.int32)
+            ids[:len(chunk)] = [nd.page for nd in chunk]
+            data = self._swap_out_fn(self._pool, self._h2d(ids))
+            self._swap_out_used = True
+            self._pending_d2h.append({"kind": "spill", "nodes": list(chunk),
+                                      "n": len(chunk), "data": data,
+                                      "fetched": False})
+        return {nd.node_id for nd in accept}
+
+    def _materialize_spill(self, rec: Dict[str, object]) -> None:
+        """Fetch a spill record's gathered pages into the host tier
+        (idempotent; pads discarded).  Raises FaultInjected under an
+        injected d2h failure — the caller degrades spill -> drop."""
+        if rec.get("fetched"):
+            return
+        self._faults.d2h()
+        t0 = self._now()
+        with self._span("engine.swap.d2h"):
+            data = {name: np.asarray(a) for name, a in rec["data"].items()}
+        self._swap_ms_c.inc((self._now() - t0) * 1e3)
+        rec["fetched"] = True
+        tier = self.cache._tier
+        landed = 0
+        for i, node in enumerate(rec["nodes"]):
+            if tier is not None and tier.is_pending(node.node_id):
+                tier.fill(node.node_id,
+                          {name: np.ascontiguousarray(a[:, i])
+                           for name, a in data.items()})
+                landed += 1
+        self._tier_spills.inc(landed)
+
+    def _degrade_spill_to_drop(self, rec: Dict[str, object]) -> None:
+        """A spill whose d2h copy failed drops its nodes from the index —
+        the pages were already reclaimed, so the only cost is that a later
+        match re-prefills instead of restoring.  Nothing leaks."""
+        rec["fetched"] = True           # never retried
+        tier = self.cache._tier
+        pend = [nd for nd in rec["nodes"]
+                if tier is not None and tier.is_pending(nd.node_id)]
+        self.cache.drop_tier_nodes(pend)
+
+    def _flush_pending_spills(self) -> None:
+        """Materialize every deferred spill fetch NOW (a tier restore needs
+        the bytes) — swap records stay deferred for their usual
+        post-dispatch drain."""
+        rest: List[Dict[str, object]] = []
+        for rec in self._pending_d2h:
+            if rec["kind"] == "spill" and not rec.get("fetched"):
+                try:
+                    self._materialize_spill(rec)
+                except FaultInjected:
+                    self._degrade_spill_to_drop(rec)
+            else:
+                rest.append(rec)
+        self._pending_d2h = rest
+
+    def _tier_restore(self, slot: int, plan, rid: int) -> bool:
+        """Scatter a matched prefix's parked KV from the host/disk tier into
+        `slot`'s freshly allocated pages — ONE `swap_in_pages` dispatch for
+        the whole plan, after which the restored full pages are device
+        prefix pages again (`commit_restore`).  Returns False when the
+        restore degraded (failed h2d copy, vanished tier data): the plan's
+        nodes are dropped and the caller re-matches — the request
+        re-prefills those positions instead, nothing leaks."""
+        mgr = self.cache
+        tier = mgr._tier
+        if any(tier.is_pending(node.node_id) for _, node, _ in plan):
+            self._flush_pending_spills()
+        nodes = [node for _, node, _ in plan]
+        try:
+            datas = [mgr.tier_data(node) for node in nodes]
+            self._faults.h2d()
+        except (KeyError, RuntimeError):
+            # FaultInjected is a RuntimeError; real vanished-data errors
+            # degrade identically — drop the nodes, let the caller re-match
+            mgr.drop_tier_nodes(nodes)
+            return False
+        k = len(plan)
+        ids = np.zeros((mgr.max_pages_per_slot,), np.int32)
+        staging: Dict[str, np.ndarray] = {}
+        for name, a in datas[0].items():
+            staging[name] = np.zeros(
+                (a.shape[0], mgr.max_pages_per_slot) + a.shape[1:], a.dtype)
+        for i, ((dst, node, ntok), d) in enumerate(zip(plan, datas)):
+            ids[i] = dst
+            for name, a in d.items():
+                staging[name][:, i] = a
+        t0 = self._now()
+        with self._span("engine.swap.h2d"):
+            up = {name: self._h2d(a) for name, a in staging.items()}
+            self._pool = self._swap_in_fn(self._pool, self._h2d(ids), up)
+        self._swap_in_used = True
+        self._swap_ms_c.inc((self._now() - t0) * 1e3)
+        mgr.commit_restore(slot, plan)
+        tokens = sum(ntok for _, _, ntok in plan)
+        self._tier_restores.inc()
+        self._tier_restored_tokens.inc(tokens)
+        self._tev(rid, "tier_restore", slot=slot, pages=int(k),
+                  tokens=int(tokens))
+        return True
 
     def _drop_preempted(self, rid: int) -> Optional[Dict[str, object]]:
         """Remove a resume record on abort/timeout, clearing any host swap
@@ -1908,12 +2118,31 @@ class LLMEngine:
                 # pool would wedge the queue head forever
                 break
             tokens = prompt if self.prefix_cache else None
-            try:
-                # one shot: the prefix match and the reservation happen in the
-                # same call (a failed attempt rolls its sharing back), instead
-                # of re-hashing the prompt in a can_allocate probe every step
-                row, matched, cow = mgr.allocate_prefixed(slot, total, tokens)
-            except RuntimeError:            # out of KV pages
+            alloc = None
+            restored = ()
+            while True:
+                try:
+                    # one shot: the prefix match and the reservation happen in
+                    # the same call (a failed attempt rolls its sharing back),
+                    # instead of re-hashing the prompt in a can_allocate probe
+                    # every step
+                    alloc = mgr.allocate_prefixed(slot, total, tokens)
+                except RuntimeError:        # out of KV pages
+                    alloc = None
+                    break
+                plan = mgr.take_restore(slot)
+                if not plan:
+                    break
+                # the match reached into the KV tier: ONE swap_in scatter
+                # restores the parked prefix into the slot's fresh pages —
+                # no prefill replay.  A degraded restore (failed copy,
+                # vanished data) dropped the offending nodes; roll the slot
+                # back and re-match without them.
+                if self._tier_restore(slot, plan, rid):
+                    restored = plan
+                    break
+                mgr.release(slot)
+            if alloc is None:
                 if not self._running and not self._prefilling and \
                         mgr.pages_in_use() == 0:
                     # backstop (near-unreachable since add_request rejects
@@ -1923,6 +2152,7 @@ class LLMEngine:
                         f"{mgr.pages_needed(total)} pages but the pool only "
                         f"has {mgr.num_pages - 1}; raise num_pages")
                 break                       # wait for pages to free up
+            row, matched, cow = alloc
             self._queue.popleft()
             self._free_slots.pop()
             lc = self._lifecycles.get(rid)
@@ -1951,6 +2181,12 @@ class LLMEngine:
                                            self._h2d(dst, np.int32))
                 self._cow_copies.inc()
                 self._copy_used = True
+            if cow is not None or any(ntok < mgr.page_size
+                                      for _, _, ntok in restored):
+                # rolling-hash partial-page hit: the match ended INSIDE a
+                # cached page (device COW copy or tier scatter of the
+                # matched fraction)
+                self._partial_hits.inc()
             if matched:
                 self._prefix_cached_tokens.inc(matched)
                 self._prefix_hit_requests.inc()
@@ -2260,12 +2496,13 @@ class LLMEngine:
         _ = self.predicted_step_ms
 
     def warm_swap(self) -> None:
-        """Compile the preemption swap gather/scatter against null-page ids
-        (all content lands on the never-read page 0) — benches call this in
-        warmup so an oversubscribed run's first preemption doesn't pay a
-        compile inside the timed section.  No-op unless the engine can
-        actually swap (optimistic admission + preempt="swap")."""
-        if not (self.optimistic and self.preempt == "swap"):
+        """Compile the swap gather/scatter against null-page ids (all
+        content lands on the never-read page 0) — benches call this in
+        warmup so the first preemption swap-out OR KV-tier spill/restore
+        (both ride the SAME two executables) doesn't pay a compile inside
+        the timed section.  No-op unless the engine can reach them
+        (optimistic admission + preempt="swap", or kv_tier on)."""
+        if not ((self.optimistic and self.preempt == "swap") or self.kv_tier):
             return
         mgr = self.cache
         ids = np.zeros((mgr.max_pages_per_slot,), np.int32)
@@ -2294,16 +2531,24 @@ class LLMEngine:
         finished.append(out)
         return True
 
-    def swap_pool_bytes(self) -> int:
-        """Worst-case HOST memory the swap pool may hold (the declared
-        bound `swap_pool_pages` times the bytes one page occupies across all
-        layers and pool lanes — k + v, plus the per-token scale lanes of an
-        int8 pool, `quantization.serving.kv_page_bytes`) — the number
+    def host_pool_bytes(self) -> int:
+        """Worst-case HOST memory the unified host pool may hold — the
+        declared bound `swap_pool_pages` (shared by preemption swap parking
+        AND the kv_tier spilled-prefix store; disk pages are off-budget)
+        times the bytes one page occupies across all layers and pool lanes
+        (k + v, plus the per-token scale lanes of an int8 pool,
+        `quantization.serving.kv_page_bytes`) — the number
         `tools/tpu_cost.py` audits against
-        `SERVE_RESOURCE_BUDGET["swap_pool_bytes"]` (JXP009; int8 pools swap
-        int8 pages, so their bound shrinks with the pool).  Occupancy is the
-        `kv_pages_swapped` gauge; this is the ceiling."""
+        `SERVE_RESOURCE_BUDGET["host_pool_bytes"]` (JXP009; int8 pools park
+        int8 pages, so their bound shrinks with the pool).  Occupancy is
+        `kv_pages_swapped` + `kv_tier_pages_host`; this is the ceiling."""
         return self.swap_pool_pages * self._kv_page_bytes
+
+    def swap_pool_bytes(self) -> int:
+        """Legacy alias for `host_pool_bytes` (the PR-10 name, kept for
+        external consumers — the budget it maps to is now the unified
+        host-pool ceiling)."""
+        return self.host_pool_bytes()
 
     def kv_pool_bytes(self) -> int:
         """At-rest bytes of the device KV page pool (all lanes — the number
@@ -2540,6 +2785,25 @@ class LLMEngine:
             "swapped": self.cache.swapped_requests,
             "kv_pages_swapped": self.cache.swapped_page_count,
             "kv_pool_pressure": round(self.cache.pool_pressure(), 4),
+            # KV-tier surface (ROADMAP item 3): spilled-prefix occupancy per
+            # tier level + the spill/restore traffic and rolling-hash
+            # partial-index hits the multi-turn bench keys on
+            "kv_tier": {
+                "enabled": self.kv_tier,
+                "spill_dir": self.spill_dir,
+                "pages_host": self.cache.tier_pages_host,
+                "pages_disk": self.cache.tier_pages_disk,
+                "spills": self._tier_spills.value,
+                "restores": self._tier_restores.value,
+                "restored_tokens": self._tier_restored_tokens.value,
+                "partial_page_hits": self._partial_hits.value,
+                "disk_spills": 0 if self.cache._tier is None
+                               else self.cache._tier.disk_spills,
+                "disk_restores": 0 if self.cache._tier is None
+                                 else self.cache._tier.disk_restores,
+                "tier_drops": 0 if self.cache._tier is None
+                              else self.cache._tier.tier_drops,
+            },
             # quantized serving surface: the knobs and the at-rest pool bytes
             # the capacity math is about (None = full-precision default)
             "weight_dtype": self.weight_dtype,
@@ -2667,6 +2931,7 @@ class LLMEngine:
                 "spec_len": self.spec_len, "fused": self.fused,
                 "double_buffer": self.double_buffer,
                 "admission": self.admission, "preempt": self.preempt,
+                "kv_tier": self.kv_tier, "spill_dir": self.spill_dir,
                 "mp": self.mp, "weight_dtype": self.weight_dtype,
                 "kv_dtype": self.kv_dtype,
                 "request_tracing": self._req_tracing,
@@ -2681,6 +2946,8 @@ class LLMEngine:
                 "pool_pressure": round(mgr.pool_pressure(), 4),
                 "kv_pool_bytes": self.kv_pool_bytes(),
                 "swap_pool_pages": self.swap_pool_pages,
+                "kv_tier_pages_host": mgr.tier_pages_host,
+                "kv_tier_pages_disk": mgr.tier_pages_disk,
             },
             "requests": self._request_states(finished_limit),
             "step_trace": self.step_trace(),

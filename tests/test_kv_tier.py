@@ -1,0 +1,339 @@
+"""KV tiering (ISSUE 15): device -> host prefix spill, one-scatter session
+restore, the rolling-hash partial-page index, the disk level, fault
+degradation, and the unified host-pool accounting.
+
+The load-bearing bars:
+- byte-exact greedy parity for a session resumed from the host tier (and
+  from a `spill_dir` disk tier) vs the undisturbed engine AND vs the full
+  re-prefill (`kv_tier=False`) baseline;
+- the eviction cascade device -> host -> disk -> drop keeps
+  `check_invariants` green with zero leaked pages at every level;
+- `FaultPlan.fail_d2h` degrades spill -> drop and `fail_h2d` degrades
+  restore -> re-prefill, both parity-lossless;
+- `host_pool_room` counts spilled prefix pages against the same ceiling as
+  preemption swap parking, and `tier_make_room` reclaims tier room for live
+  victims;
+- the multi-turn bench: returning-session prefill drops >= 50% and TTFT p50
+  improves vs --no-kv-tier on the same stream, byte-exact parity, zero new
+  compiled programs (spill/restore reuse the <= 2 swap bucket).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.inference.cache import PagedKVCache
+from paddle_tpu.inference.engine import LLMEngine
+from paddle_tpu.inference.faults import FaultPlan
+from paddle_tpu.models import gpt as G
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return G.gpt_tiny(64)
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return G.init_params(cfg, jax.random.key(0))
+
+
+def _engine(params, cfg, **kw):
+    base = dict(num_slots=2, page_size=8, num_pages=9, max_model_len=64,
+                prefill_chunk=16, seed=3, swap_pool_pages=64)
+    base.update(kw)
+    return LLMEngine(params, cfg, **base)
+
+
+def _session_stream(eng, rng_seed=7, churn=6):
+    """Turn 1 of a session, distinct-prompt churn that evicts its pages,
+    then the returning turn (prompt + reply + fresh tokens).  Returns
+    (outputs keyed oldest-first, returning-turn output)."""
+    rng = np.random.RandomState(rng_seed)
+    shared = rng.randint(0, eng.config.vocab_size, (20,)).astype(np.int32)
+    outs = {}
+    r1 = eng.add_request(shared, max_new_tokens=5)
+    outs.update(eng.run())
+    for _ in range(churn):
+        eng.add_request(rng.randint(0, eng.config.vocab_size, (30,))
+                        .astype(np.int32), max_new_tokens=4)
+    outs.update(eng.run())
+    t2 = np.concatenate([shared, np.asarray(outs[r1].token_ids, np.int32),
+                         rng.randint(0, eng.config.vocab_size, (4,))
+                         .astype(np.int32)])
+    r2 = eng.add_request(t2, max_new_tokens=5)
+    outs.update(eng.run())
+    return outs, outs[r2]
+
+
+# ---------------------------------------------------------------------------
+# resumed-from-host parity + counters
+# ---------------------------------------------------------------------------
+
+def test_host_restore_parity_and_counters(params, cfg):
+    """A returning session whose pages were LRU-evicted restores from the
+    host tier with ONE scatter: tokens byte-identical to both the
+    drop-on-evict baseline (full re-prefill) and a direct `generate`, with
+    the spill/restore counters moving and zero page leaks."""
+    eng = _engine(params, cfg)
+    outs, ret = _session_stream(eng)
+    base_eng = _engine(params, cfg, kv_tier=False)
+    base_outs, base_ret = _session_stream(base_eng)
+    for a, b in zip(sorted(outs), sorted(base_outs)):
+        assert outs[a].token_ids == base_outs[b].token_ids
+    ref = G.generate(params, jnp.asarray(ret.prompt)[None], cfg,
+                     max_new_tokens=5)
+    np.testing.assert_array_equal(ret.tokens, np.asarray(ref[0]))
+
+    st, base_st = eng.stats(), base_eng.stats()
+    assert st["kv_tier"]["enabled"] and not base_st["kv_tier"]["enabled"]
+    assert st["kv_tier"]["spills"] > 0
+    assert st["kv_tier"]["restores"] >= 1
+    assert st["kv_tier"]["restored_tokens"] >= 16     # >= 2 full pages
+    assert base_st["kv_tier"]["spills"] == 0
+    # the restored tokens were NOT re-prefilled: the tier pass computes less
+    assert st["prefilled_tokens"] < base_st["prefilled_tokens"]
+    # spill/restore reuse the two swap executables — nothing new compiles
+    assert st["swap_executables"] <= 2
+    assert st["decode_executables"] + st["verify_executables"] == 1
+    eng.cache.check_invariants()
+    assert eng.cache.swapped_page_count == 0
+
+
+def test_restore_from_spill_dir_parity(params, cfg, tmp_path):
+    """With a tight host budget and `spill_dir`, over-budget tier content
+    cascades to disk and restores from there transparently — same tokens as
+    the re-prefill baseline."""
+    eng = _engine(params, cfg, swap_pool_pages=6, spill_dir=str(tmp_path))
+    outs, ret = _session_stream(eng)
+    base_eng = _engine(params, cfg, kv_tier=False)
+    base_outs, _ = _session_stream(base_eng)
+    for a, b in zip(sorted(outs), sorted(base_outs)):
+        assert outs[a].token_ids == base_outs[b].token_ids
+    st = eng.stats()
+    assert st["kv_tier"]["disk_spills"] > 0
+    assert st["kv_tier"]["restores"] >= 1
+    assert st["kv_tier"]["pages_host"] <= 6           # budget respected
+    eng.cache.check_invariants()
+
+
+def test_eviction_cascade_to_drop_no_leaks(params, cfg, tmp_path):
+    """device -> host -> disk -> drop: with a capped disk level the oldest
+    spilled prefixes fall off the end; every level's accounting stays exact
+    under check_invariants and nothing leaks."""
+    eng = _engine(params, cfg, swap_pool_pages=4, spill_dir=str(tmp_path),
+                  spill_disk_pages=3)
+    rng = np.random.RandomState(11)
+    for _ in range(10):
+        eng.add_request(rng.randint(0, cfg.vocab_size, (30,))
+                        .astype(np.int32), max_new_tokens=4)
+        eng.run()
+        eng.cache.check_invariants()
+    st = eng.stats()["kv_tier"]
+    assert st["pages_host"] <= 4
+    assert st["pages_disk"] <= 3
+    assert st["disk_spills"] > 0 and st["tier_drops"] > 0
+    # drop really deletes the files
+    import os
+    assert len(os.listdir(str(tmp_path))) == eng.cache.tier_pages_disk
+    eng.cache.check_invariants()
+
+
+def test_no_tier_when_disabled_or_unbudgeted(params, cfg):
+    """kv_tier=False, prefix_cache=False, and swap_pool_pages=0 all disable
+    tiering cleanly: evictions drop as in PR 10, stats say so."""
+    for kw in (dict(kv_tier=False), dict(prefix_cache=False),
+               dict(swap_pool_pages=0)):
+        eng = _engine(params, cfg, **kw)
+        assert not eng.kv_tier
+        rng = np.random.RandomState(1)
+        for _ in range(4):
+            eng.add_request(rng.randint(0, cfg.vocab_size, (30,))
+                            .astype(np.int32), max_new_tokens=3)
+        eng.run()
+        st = eng.stats()["kv_tier"]
+        assert st["spills"] == 0 and st["pages_host"] == 0
+        eng.cache.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# rolling-hash partial-page index
+# ---------------------------------------------------------------------------
+
+def test_rolling_hash_partial_tail_of_full_page():
+    """A prompt sharing only a partial tail of a cached FULL page COW-copies
+    the matched fraction — the case the PR-2 exact-content index could never
+    hit (it only matched pages registered under exactly that partial
+    content)."""
+    mgr = PagedKVCache(num_pages=16, page_size=4, num_slots=4,
+                       max_pages_per_slot=8)
+    tok = np.arange(12, dtype=np.int32)             # 3 full pages
+    mgr.allocate_prefixed(0, 12, tok)
+    mgr.register_prefix(0, tok, 12)
+    # new prompt: first page + HALF the second page, then diverges
+    div = np.concatenate([tok[:6], np.asarray([77, 77, 77, 77], np.int32)])
+    row, m, cow = mgr.allocate_prefixed(1, 12, div)
+    assert m == 6                                   # 4 full + 2 partial
+    assert cow is not None and cow[0] == mgr.slot_pages(0)[1]
+    # divergent tail beyond the verified prefix does not match
+    bad = np.concatenate([tok[:4], np.asarray([9, 9, 9], np.int32)])
+    _, m2, cow2 = mgr.allocate_prefixed(2, 8, bad)
+    assert m2 == 4 and cow2 is None
+    mgr.check_invariants()
+
+
+def test_rolling_hash_engine_parity(params, cfg):
+    """Engine-level: a request sharing a partial tail of a cached page is
+    token-identical to `generate` (the COW'd fraction is real KV), and the
+    partial_page_hits counter moves."""
+    eng = _engine(params, cfg)
+    rng = np.random.RandomState(5)
+    donor = rng.randint(0, cfg.vocab_size, (24,)).astype(np.int32)
+    eng.add_request(donor, max_new_tokens=3)
+    eng.run()
+    # shares donor's first 12 tokens: page 1 full + half of page 2
+    probe = np.concatenate([donor[:12],
+                            rng.randint(0, cfg.vocab_size, (6,))
+                            .astype(np.int32)])
+    rid = eng.add_request(probe, max_new_tokens=5)
+    outs = eng.run()
+    ref = G.generate(params, jnp.asarray(probe)[None], cfg, max_new_tokens=5)
+    np.testing.assert_array_equal(outs[rid].tokens, np.asarray(ref[0]))
+    assert outs[rid].cached_tokens == 12
+    assert eng.stats()["kv_tier"]["partial_page_hits"] >= 1
+    eng.cache.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# fault degradation: spill -> drop, restore -> re-prefill
+# ---------------------------------------------------------------------------
+
+def test_fail_d2h_degrades_spill_to_drop(params, cfg):
+    """Every spill d2h copy fails: nodes drop from the index (no restores
+    ever), outputs identical to the no-tier baseline, nothing leaks."""
+    eng = _engine(params, cfg, fault_plan=FaultPlan(fail_d2h=1000))
+    outs, _ = _session_stream(eng)
+    base_eng = _engine(params, cfg, kv_tier=False)
+    base_outs, _ = _session_stream(base_eng)
+    for a, b in zip(sorted(outs), sorted(base_outs)):
+        assert outs[a].token_ids == base_outs[b].token_ids
+    st = eng.stats()["kv_tier"]
+    assert st["spills"] == 0 and st["restores"] == 0
+    assert st["pages_host"] == 0 and st["pages_disk"] == 0
+    eng.cache.check_invariants()
+
+
+def test_fail_h2d_degrades_restore_to_reprefill(params, cfg):
+    """Spills land, but every restore h2d fails: the matched nodes drop and
+    the request re-prefills — same tokens, no partial restore ever visible,
+    zero leaks."""
+    eng = _engine(params, cfg, fault_plan=FaultPlan(fail_h2d=1000))
+    outs, _ = _session_stream(eng)
+    base_eng = _engine(params, cfg, kv_tier=False)
+    base_outs, _ = _session_stream(base_eng)
+    for a, b in zip(sorted(outs), sorted(base_outs)):
+        assert outs[a].token_ids == base_outs[b].token_ids
+    st = eng.stats()["kv_tier"]
+    assert st["spills"] > 0
+    assert st["restores"] == 0 and st["restored_tokens"] == 0
+    eng.cache.check_invariants()
+    assert eng.cache.swapped_page_count == 0
+
+
+# ---------------------------------------------------------------------------
+# unified host pool: room accounting + reclamation for live victims
+# ---------------------------------------------------------------------------
+
+def test_host_pool_room_counts_tier_pages(params, cfg):
+    """Spilled prefix pages consume the SAME budget as preemption swap
+    parking: host_pool_room reflects them, and tier_make_room reclaims
+    (drops, with no disk level) room on demand."""
+    eng = _engine(params, cfg, swap_pool_pages=8)
+    rng = np.random.RandomState(2)
+    for _ in range(5):
+        eng.add_request(rng.randint(0, cfg.vocab_size, (30,))
+                        .astype(np.int32), max_new_tokens=3)
+        eng.run()
+    mgr = eng.cache
+    held = mgr.tier_pages_host
+    assert held > 0
+    assert mgr.host_pool_room(8) == 8 - held
+    freed = mgr.tier_make_room(2)
+    assert freed == 2
+    assert mgr.host_pool_room(8) == 8 - held + 2
+    mgr.check_invariants()
+
+
+def test_preemption_swap_reclaims_tier_room(params, cfg):
+    """preempt="swap" with the host pool full of spilled prefixes: the
+    victim still parks — live work evicts cached prefixes from the unified
+    pool instead of degrading to recompute."""
+    prompts = [np.arange(i * 7, i * 7 + 20, dtype=np.int32) % cfg.vocab_size
+               for i in range(6)]
+    eng = _engine(params, cfg, num_slots=6, prefill_chunk=8,
+                  admission="optimistic", preempt="swap", swap_pool_pages=8)
+    for p in prompts:
+        eng.add_request(p.astype(np.int32), max_new_tokens=24)
+    eng.run()
+    st = eng.stats()
+    assert st["preemptions"] > 0
+    assert st["preempt_swaps"] > 0      # parking never starved by the tier
+    eng.cache.check_invariants()
+    assert eng.cache.swapped_page_count == 0
+
+
+# ---------------------------------------------------------------------------
+# the multi-turn bench: the ISSUE-15 acceptance bar
+# ---------------------------------------------------------------------------
+
+def test_bench_multi_turn_tier_acceptance(params, cfg):
+    """CPU-smoke --multi-turn: returning-session prefilled tokens drop
+    >= 50% and returning TTFT p50 improves vs --no-kv-tier on the same
+    stream, with byte-exact greedy parity and zero new compiled programs
+    (decode-side 1, swap bucket <= 2) — and the v2 trajectory row built
+    from the run passes schema + floors."""
+    from bench_serve import run_serve_bench
+    from tools.check_bench import bench_row, check_floors, validate_row
+
+    kw = dict(config=cfg, params=params, num_requests=12, num_slots=4,
+              page_size=8, max_model_len=64, max_new_tokens=6,
+              prefill_chunk=8, multi_turn=3, seed=0)
+    tier = run_serve_bench(kv_tier=True, **kw)
+    base = run_serve_bench(kv_tier=False, **kw)
+    assert tier["outputs_digest"] == base["outputs_digest"]
+    assert tier["resume_hits"] > 0 and tier["resume_restored_tokens"] > 0
+    drop = 1.0 - tier["returning_prefilled_tokens"] / \
+        max(base["returning_prefilled_tokens"], 1)
+    assert drop >= 0.5, (tier["returning_prefilled_tokens"],
+                         base["returning_prefilled_tokens"])
+    assert tier["returning_ttft_p50_ms"] < base["returning_ttft_p50_ms"]
+    assert tier["decode_executables"] + tier["verify_executables"] == 1
+    assert tier["swap_executables"] <= 2
+
+    stats = dict(tier)
+    stats["kv_tier_parity"] = \
+        tier["outputs_digest"] == base["outputs_digest"]
+    stats["returning_prefilled_drop"] = round(drop, 4)
+    row = bench_row(stats)
+    assert row["schema_version"] == 2
+    assert validate_row(row) == []
+    assert check_floors(row) == []
+    assert row["mode"]["kv_tier"] is True and row["mode"]["multi_turn"] == 3
+    assert row["parity"]["kv_tier_parity"] is True
+
+
+def test_check_bench_v1_rows_still_parse():
+    """Old trajectory rows (schema v1) keep validating against the v1 axis
+    sets; unknown versions fail loudly."""
+    from tools.check_bench import (MODE_AXES_V1, PERF_KEYS_V1, validate_row)
+    v1 = {"schema_version": 1, "t": 1.0,
+          "mode": {k: None for k in MODE_AXES_V1},
+          "perf": {k: None for k in PERF_KEYS_V1},
+          "parity": {}}
+    v1["perf"]["decode_tokens_per_sec_per_chip"] = 100.0
+    assert validate_row(v1) == []
+    v9 = dict(v1, schema_version=9)
+    assert any("schema_version" in e for e in validate_row(v9))

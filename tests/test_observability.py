@@ -429,6 +429,10 @@ NEW_STATS_KEYS = frozenset({
     # added by the health & signals PR: windowed rates, the folded health
     # state, and the live roofline account
     "rates", "health", "roofline",
+}) | frozenset({
+    # added by the KV tiering PR: per-tier occupancy + spill/restore traffic
+    # + the rolling-hash partial-index hit counter
+    "kv_tier",
 })
 
 

@@ -133,9 +133,13 @@ def test_optimistic_growth_tracks_live_tokens(cfg, params):
 @pytest.mark.parametrize("preempt", ["recompute", "swap"])
 def test_preemption_parity_and_no_leaks(cfg, params, reference, preempt):
     prompts, ref_tokens = reference
+    # kv_tier off: this test proves the PREEMPTION machinery's program
+    # accounting in isolation (the default-on tier shares the two swap
+    # executables and would mask a recompute path that wrongly compiled
+    # them; the tier's own program accounting lives in tests/test_kv_tier)
     eng = LLMEngine(params, cfg, num_slots=6, page_size=8, num_pages=9,
                     max_model_len=64, prefill_chunk=8,
-                    admission="optimistic", preempt=preempt)
+                    admission="optimistic", preempt=preempt, kv_tier=False)
     rids = [eng.add_request(p, max_new_tokens=24) for p in prompts]
     outs, st = _drain_checked(eng)
     assert st["preemptions"] > 0

@@ -38,8 +38,10 @@ def test_cache_shared_page_freed_only_at_refcount_zero():
     assert m0 == 0 and cow0 is None             # cold cache
     mgr.register_prefix(0, tok, 10)
     row1, m1, cow1 = mgr.allocate_prefixed(1, 12, tok)
-    # page-aligned match capped below len(tokens): 2 full pages, and the
-    # 2-token partial cannot match (only j <= r-1 = 1 is probed)
+    # page-aligned match capped below len(tokens): 2 full pages; the 2-token
+    # partial cannot match (only j <= lp - base - 1 = 1 is probed, and the
+    # rolling-hash partial index only matches tails >= _MIN_PARTIAL = 2 —
+    # a 1-token hit would cost a COW copy to save one prefill token)
     assert m1 == 8 and cow1 is None
     np.testing.assert_array_equal(row1[:2], row0[:2])   # physically shared
     assert row1[2] != row0[2]
@@ -205,9 +207,12 @@ def test_engine_prefix_cached_matches_uncached_generation():
     # base: 21 = 2 full pages + 5-token partial; ext COWs the partial
     assert outs[rids[0]].cached_tokens == 0
     assert outs[rids[1]].cached_tokens == 21
-    assert outs[rids[2]].cached_tokens == 16    # partial capped at lp-1
+    # C's partial tail hits the rolling-hash index at j = lp - 16 - 1 = 4
+    # (a prefix of the 5-token partial node; the PR-2 exact-content index
+    # stopped at the 2 full pages = 16 here)
+    assert outs[rids[2]].cached_tokens == 20
     st = eng.stats()
-    assert st["cow_page_copies"] == 1
+    assert st["cow_page_copies"] == 2   # B's partial COW + C's rolling-hash hit
     assert st["prefix_hit_requests"] == 2
     assert st["pages_in_use"] == 0
     assert all(outs[r].ttft_s is not None and outs[r].ttft_s > 0 for r in rids)

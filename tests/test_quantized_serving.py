@@ -316,7 +316,7 @@ def test_cost_checks_quantized_clean():
     assert rep["at_rest_quantized"]["pool_bytes"] < rep["at_rest"]["pool_bytes"]
     assert rep["at_rest_quantized"]["param_bytes_replicated"] < \
         rep["at_rest"]["param_bytes_replicated"]
-    assert rep["swap_pool_bytes_int8"] < rep["swap_pool_bytes"]
+    assert rep["host_pool_bytes_int8"] < rep["host_pool_bytes"]
     names = [p["name"] for p in rep["programs"]]
     assert "serve.fused_step_int8" in names
 

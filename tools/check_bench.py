@@ -39,29 +39,41 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_HISTORY = os.path.join(_REPO, "BENCH_SERVE.jsonl")
 
-ROW_SCHEMA_VERSION = 1
+ROW_SCHEMA_VERSION = 2
 
 # the axes that make rows comparable across PRs: two rows agree on "mode"
-# or their perf numbers are not the same experiment
-MODE_AXES = ("mp", "fused", "spec_len", "prefill_chunk", "weight_dtype",
-             "kv_dtype", "oversubscribe", "preempt_mode", "admission",
-             "request_tracing")
+# or their perf numbers are not the same experiment.  v1 rows (pre KV
+# tiering) validate against the v1 sets — old history stays parseable.
+MODE_AXES_V1 = ("mp", "fused", "spec_len", "prefill_chunk", "weight_dtype",
+                "kv_dtype", "oversubscribe", "preempt_mode", "admission",
+                "request_tracing")
+# v2 (KV tiering PR): the tier switch and the multi-turn session axes
+MODE_AXES = MODE_AXES_V1 + ("kv_tier", "multi_turn", "session_return_frac")
 # the perf surface a trajectory reader plots; absent-in-this-mode metrics
 # (e.g. goodput_ratio without --oversubscribe) ride as null
-PERF_KEYS = ("decode_tokens_per_sec_per_chip", "generated_tokens_per_sec",
-             "goodput_tokens_per_sec", "goodput_ratio",
-             "dispatches_per_step", "host_sync_ms_per_step",
-             "predicted_step_ms", "measured_step_ms", "model_error",
-             "roofline_drift", "steady_state_recompiles",
-             "fused_speedup", "spec_speedup", "accepted_per_step",
-             "tracing_overhead", "tracing_overhead_measured",
-             "preemptions_per_step", "prefix_hit_rate",
-             "ttft_p50_ms", "ttft_p99_ms", "tpot_p99_ms",
-             "requests", "elapsed_s", "device_spec")
+PERF_KEYS_V1 = ("decode_tokens_per_sec_per_chip", "generated_tokens_per_sec",
+                "goodput_tokens_per_sec", "goodput_ratio",
+                "dispatches_per_step", "host_sync_ms_per_step",
+                "predicted_step_ms", "measured_step_ms", "model_error",
+                "roofline_drift", "steady_state_recompiles",
+                "fused_speedup", "spec_speedup", "accepted_per_step",
+                "tracing_overhead", "tracing_overhead_measured",
+                "preemptions_per_step", "prefix_hit_rate",
+                "ttft_p50_ms", "ttft_p99_ms", "tpot_p99_ms",
+                "requests", "elapsed_s", "device_spec")
+# v2: tier spill/restore traffic + the returning-session view the tier's
+# win is measured on (prefilled_tokens rides along so the drop is
+# recomputable from any two rows)
+PERF_KEYS = PERF_KEYS_V1 + (
+    "prefilled_tokens", "resume_hits", "resume_restored_tokens",
+    "partial_page_hits", "returning_prefilled_tokens",
+    "returning_prefilled_drop", "returning_ttft_p50_ms")
 PARITY_KEYS = ("fuse_parity", "spec_parity", "oversubscribe_parity",
-               "tracing_parity")
+               "tracing_parity", "kv_tier_parity")
 REQUIRED_ROW_KEYS = frozenset({"schema_version", "t", "mode", "perf",
                                "parity"})
+_AXES_BY_VERSION = {1: (MODE_AXES_V1, PERF_KEYS_V1),
+                    2: (MODE_AXES, PERF_KEYS)}
 
 
 def bench_row(stats, t=None):
@@ -85,13 +97,15 @@ def validate_row(row):
     if missing:
         errors.append(f"row missing keys: {sorted(missing)}")
         return errors
-    if row["schema_version"] != ROW_SCHEMA_VERSION:
-        errors.append(f"schema_version {row['schema_version']!r} != "
-                      f"{ROW_SCHEMA_VERSION} (migrate the row or bump the "
-                      f"reader)")
+    if row["schema_version"] not in _AXES_BY_VERSION:
+        errors.append(f"schema_version {row['schema_version']!r} not in "
+                      f"{sorted(_AXES_BY_VERSION)} (migrate the row or bump "
+                      f"the reader)")
+        return errors
+    mode_axes, perf_keys = _AXES_BY_VERSION[row["schema_version"]]
     if not isinstance(row["t"], (int, float)) or row["t"] <= 0:
         errors.append(f"bad timestamp t={row['t']!r}")
-    for section, keys in (("mode", MODE_AXES), ("perf", PERF_KEYS)):
+    for section, keys in (("mode", mode_axes), ("perf", perf_keys)):
         if not isinstance(row[section], dict):
             errors.append(f"row[{section!r}] is not an object")
             continue
@@ -152,6 +166,16 @@ def check_floors(row, floors=None):
         errors.append(f"model_error {me!r} outside "
                       f"(0, {floors['model_error_max']}] — the roofline "
                       f"prediction is missing or broken")
+    # KV-tier capacity floor: deterministic (token counts, not wall clock)
+    # wherever a multi-turn row ran the tier comparison pass
+    drop = perf.get("returning_prefilled_drop")
+    drop_min = floors.get("returning_prefilled_drop_min")
+    if drop is not None and drop_min is not None and \
+            mode.get("kv_tier") and (mode.get("multi_turn") or 1) > 1 and \
+            drop < drop_min:
+        errors.append(f"returning_prefilled_drop {drop} below the declared "
+                      f"{drop_min} — returning sessions are re-prefilling "
+                      f"KV the tier should have restored")
     return errors
 
 

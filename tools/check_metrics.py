@@ -83,6 +83,14 @@ REQUIRED_STATS_KEYS = frozenset({
     # health & signals PR (ISSUE 13): windowed rates, the folded health
     # state, and the live roofline (predicted/measured/drift/anomalies)
     "rates", "health", "roofline",
+    # KV tiering PR (ISSUE 15): per-tier occupancy + spill/restore traffic
+    # + the rolling-hash partial-index hit counter
+    "kv_tier",
+})
+REQUIRED_KV_TIER_KEYS = frozenset({
+    "enabled", "spill_dir", "pages_host", "pages_disk", "spills",
+    "restores", "restored_tokens", "partial_page_hits", "disk_spills",
+    "disk_restores", "tier_drops",
 })
 REQUIRED_SLO_KEYS = frozenset({
     "deadline_requests", "deadline_met", "deadline_attainment",
@@ -112,6 +120,9 @@ REQUIRED_COUNTERS = frozenset({
     "intake_swap_rejects", "deadline_requests", "deadline_met",
     # health & signals PR: admission-rate numerator + anomaly counters
     "admitted_requests", "roofline_drift_alerts", "steady_state_recompiles",
+    # KV tiering PR: spill/restore traffic + rolling-hash partial hits
+    "kv_tier_spills", "kv_tier_restores", "kv_tier_restored_tokens",
+    "partial_page_hits",
 })
 REQUIRED_DEBUG_BUNDLE_KEYS = frozenset({
     "version", "t", "engine", "pool", "requests", "step_trace", "stats",
@@ -125,6 +136,8 @@ REQUIRED_GAUGES = frozenset({
     # the live roofline pair, and the SLO burn-rate pair
     "engine_health", "measured_step_ms", "roofline_drift",
     "slo_burn_rate_1m", "slo_burn_rate_5m",
+    # KV tiering PR: per-tier-level occupancy
+    "kv_tier_pages_host", "kv_tier_pages_disk",
 }) | frozenset(
     # windowed-rate pull gauges: one per (family, window)
     f"{fam}_{w}" for fam in RATE_FAMILIES for w in RATE_WINDOW_LABELS)
@@ -268,8 +281,12 @@ def run_smoke(errors):
     params = G.init_params(cfg, jax.random.key(0))
     # 8-page pool under 2 slots: retiring requests park prefixes in the LRU
     # and later distinct prompts evict them (the eviction counter must move)
+    # swap_pool_pages sized up so LRU-evicted prefixes SPILL to the host
+    # tier (default-on tiering) instead of churning out of the budget —
+    # the re-request below then restores from the tier (the restore lane)
     eng = LLMEngine(params, cfg, num_slots=2, page_size=8, num_pages=9,
-                    max_model_len=64, prefill_chunk=16, spec_len=3, seed=11)
+                    max_model_len=64, prefill_chunk=16, spec_len=3, seed=11,
+                    swap_pool_pages=64)
     rng = np.random.RandomState(11)
     shared = rng.randint(0, cfg.vocab_size, (20,)).astype(np.int32)
     rids = []
@@ -297,6 +314,20 @@ def run_smoke(errors):
         prev = cur
     if not aborted:
         errors.append("abort lane never exercised")
+    # tier restore lane: re-submit the shared-family prompt AFTER the
+    # distinct-prompt churn evicted (= spilled) its pages — admission must
+    # map the prefix from the host tier with one scatter
+    eng.add_request(np.concatenate(
+        [shared, rng.randint(0, cfg.vocab_size, (5,)).astype(np.int32)]),
+        max_new_tokens=4)
+    while eng.has_work:
+        eng.step()
+        cur = eng.metrics.snapshot()["counters"]
+        for k, v in cur.items():
+            if v < prev.get(k, 0):
+                errors.append(f"counter {k} decreased: "
+                              f"{prev[k]} -> {v} in the restore lane")
+        prev = cur
     st = eng.stats()
     if st["prefix_evictions"] < 1:
         errors.append("eviction lane never exercised "
@@ -305,6 +336,14 @@ def run_smoke(errors):
         errors.append("speculative lane never exercised (spec_events=0)")
     if st["prefix_hit_requests"] < 1:
         errors.append("prefix-hit lane never exercised")
+    if st["kv_tier"]["spills"] < 1:
+        errors.append("KV-tier spill lane never exercised "
+                      f"(kv_tier={st['kv_tier']})")
+    if st["kv_tier"]["restores"] < 1:
+        errors.append("KV-tier restore lane never exercised "
+                      f"(kv_tier={st['kv_tier']})")
+    if st["kv_tier"]["partial_page_hits"] < 1:
+        errors.append("rolling-hash partial-page lane never exercised")
     return eng, st
 
 
@@ -522,6 +561,9 @@ def main() -> int:
         rmiss = REQUIRED_ROOFLINE_KEYS - set(st["roofline"])
         if rmiss:
             errors.append(f"stats()['roofline'] missing: {sorted(rmiss)}")
+        tmiss = REQUIRED_KV_TIER_KEYS - set(st["kv_tier"])
+        if tmiss:
+            errors.append(f"stats()['kv_tier'] missing: {sorted(tmiss)}")
 
     snap = eng.metrics.snapshot()
     for section, required in (("counters", REQUIRED_COUNTERS),

@@ -12,9 +12,10 @@ cannot silently reintroduce per-shape recompiles:
 - prefill-side (chunked mode): <= 2 programs for the cold paths (the chunk
   rides the fused batch, so a chunked fused run measures 0);
 - copy: <= 1 program (the COW page copy);
-- swap: <= 2 programs (the preemption KV swap-out gather + swap-in scatter,
-  compiled only when `preempt="swap"` actually preempts — 0 on this
-  reservation-mode stream);
+- swap: <= 2 programs — the KV swap-out gather + swap-in scatter, SHARED by
+  preemption swap parking and the (default-on) KV tier's prefix
+  spill/restore; warmed by `warm_swap`, so this stream measures exactly 2
+  with zero tier-specific programs on top;
 - total: <= 6.
 
 The budget holds PER MESH CONFIG: a second pass re-measures under mp=2
@@ -68,9 +69,9 @@ def measure(mp=1):
                                    stats["verify_executables"],
         "prefill_executables": stats["prefill_executables"],
         "copy_executables": stats["copy_executables"],
-        # preemption swap gather/scatter: 0 on this reservation-mode stream
-        # (they compile only when preempt="swap" actually fires), bounded
-        # <= 2 by the declared budget either way
+        # swap gather/scatter: warmed (and used by the default-on KV tier's
+        # prefix spill/restore) on this stream — the tier must stay inside
+        # the same <= 2 bucket preemption swapping declared
         "swap_executables": stats["swap_executables"],
     }
     got["total_executables"] = (got["decode_side_executables"] +

@@ -78,8 +78,8 @@ def _print_report(reports) -> None:
                   f"{_fmt_bytes(qr['pool_bytes'])} "
                   f"({rep['quantized_pool_ratio']}x smaller), replicated "
                   f"params {_fmt_bytes(qr['param_bytes_replicated'])} (fp "
-                  f"{_fmt_bytes(ar['param_bytes_replicated'])}), swap bound "
-                  f"{_fmt_bytes(rep['swap_pool_bytes_int8'])}")
+                  f"{_fmt_bytes(ar['param_bytes_replicated'])}), host-pool "
+                  f"bound {_fmt_bytes(rep['host_pool_bytes_int8'])}")
         print(f"   {'program':28s} {'flops':>10s} {'peak HBM':>10s} "
               f"{'xla temp':>10s} {'coll B/step':>11s} {'pred ms':>8s}")
         for p in rep["programs"]:
