@@ -72,6 +72,16 @@ by N.  On CPU, simulate the chips:
 `XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \\
     python bench_serve.py --mp 2` (set automatically when absent).
 
+`--replicas N` (> 1) adds the dp ENGINE-FLEET passes: the same multi-turn
+session shape replayed through `EngineFleet` under `--router {affinity,
+round_robin,least_loaded}` — plus, always, the round-robin cache-blind
+baseline and a single-engine parity oracle on the identical pre-drawn
+stream.  The row gains fleet tokens/s, per-replica balance, the
+affinity-vs-round-robin returning-turn prefix-hit-rate and TTFT A/B
+(`affinity_prefix_hit_ratio` is floor-enforced >= 1.0 by check_bench),
+byte-exact `fleet_parity`, and `fleet_shared_executables` (replicas adopt
+the leader's compiled programs — replication adds zero executables).
+
 Latency percentiles (TTFT/TPOT/queue-time/e2e, p50/p99 ms) come from the
 ENGINE's lifecycle histograms (`stats()["latency"]`), not a bench-side list —
 the same numbers a Prometheus scrape of `engine.metrics` would see — and the
@@ -610,6 +620,180 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
     }
 
 
+def run_fleet_bench(*, replicas=2, router="affinity", num_sessions=5,
+                    turns=3, max_new_tokens=5, seed=0, config=None,
+                    params=None, num_slots=4, page_size=8,
+                    prefill_chunk=16):
+    """Multi-turn chat sessions routed through the dp `EngineFleet` — the
+    `--replicas N --router ...` axis of the serving bench.
+
+    Three passes over the SAME pre-drawn session stream (CPU-smoke shaped
+    regardless of platform — the fleet claims under test are routing and
+    program-sharing, not device throughput): a single-engine baseline (the
+    parity oracle), a `replicas`-wide fleet under the requested `router`,
+    and a `round_robin` fleet — what a cache-blind balancer in front of N
+    independent processes does.  `num_sessions` is odd by default so
+    round-robin's turn-2 assignment SHIFTS off the turn-1 one (an even
+    count would park every session back on its turn-1 replica by accident
+    and hide exactly the blindness being measured).
+
+    Returned keys (merged into the schema-v3 trajectory row):
+
+    - `fleet_generated_tokens_per_sec` + `replica_balance` (min/max
+      submitted across replicas) for the requested-router pass;
+    - the A/B: `affinity_prefix_hit_rate` vs `round_robin_prefix_hit_rate`
+      — cached fraction of RETURNING-turn (turn >= 2) prompt tokens, the
+      traffic affinity exists for — folded into
+      `affinity_prefix_hit_ratio` = (1 + affinity) / (1 + round_robin), a
+      smoothed odds ratio that stays finite when the blind side hits
+      nothing; its `>= 1.0` floor (SERVE_PERF_FLOORS) says cache-aware
+      routing never hits LESS than cache-blind;
+    - `affinity_returning_ttft_p50_ms` vs
+      `round_robin_returning_ttft_p50_ms`: the wall-clock corroboration —
+      a returning turn routed away from its KV re-prefills the whole
+      conversation and pays for it in time-to-first-token;
+    - `fleet_parity`: every pass's (session, turn) token streams byte-equal
+      to the single-engine baseline — routing must never change tokens;
+    - `fleet_shared_executables`: every pass's replicas ran the leader's
+      compiled set (`EngineFleet` adoption — dp replication adds zero
+      programs; tools/check_program_count.py holds the same bar)."""
+    import jax
+
+    from paddle_tpu.inference.router import EngineFleet
+    from paddle_tpu.models import gpt as gpt_mod
+
+    if turns < 2:
+        raise ValueError(f"fleet bench needs returning turns (turns >= 2), "
+                         f"got {turns}")
+    if config is None:
+        config = gpt_mod.gpt_tiny(64)
+    if params is None:
+        params = gpt_mod.init_params(config, jax.random.key(seed))
+    max_model_len = config.max_seq_len
+    ekw = dict(num_slots=num_slots, page_size=page_size,
+               max_model_len=max_model_len, prefill_chunk=prefill_chunk,
+               spec_len=0, seed=seed)
+
+    # pre-draw every session's first prompt and per-turn user chunks ONCE:
+    # all passes replay the identical stream, so hit-rate/TTFT deltas are
+    # pure routing policy
+    rng = np.random.RandomState(seed)
+    user_chunk = max(2, page_size // 2)
+    reserve = (turns - 1) * (max_new_tokens + user_chunk) + max_new_tokens
+    first_max = max_model_len - reserve
+    if first_max <= page_size:
+        raise ValueError(f"turns={turns} leaves only {first_max} first-turn "
+                         f"prompt tokens at max_model_len={max_model_len}")
+    sessions = [f"s{i}" for i in range(num_sessions)]
+    prompts = {s: rng.randint(0, config.vocab_size,
+                              (int(rng.randint(page_size, first_max + 1)),)
+                              ).astype(np.int32).tolist()
+               for s in sessions}
+    chunks = {(s, t): rng.randint(0, config.vocab_size, (user_chunk,)
+                                  ).astype(np.int32).tolist()
+              for s in sessions for t in range(2, turns + 1)}
+    warm_rng = np.random.RandomState(seed + 1)
+    warm_prompt = warm_rng.randint(0, config.vocab_size,
+                                   (2 * page_size + 3,)).astype(np.int32)
+    warm_tail = warm_rng.randint(0, config.vocab_size,
+                                 (user_chunk + max_new_tokens,)
+                                 ).astype(np.int32)
+
+    def _pass(n_replicas, policy):
+        fleet = EngineFleet(params, config, replicas=n_replicas,
+                            router=policy, engine_kwargs=ekw)
+        shared = fleet.shared_executables()
+        # compile outside the timed section: a throwaway prompt through the
+        # leader covers the chunk-prefill + fused-decode shapes, and a
+        # second prompt EXTENDING it covers the prefix-hit prefill lane
+        # (page mapping + partial-page restore) every returning turn rides
+        # — without that the first cached prefill's compile lands in the
+        # timed section and charges the affinity side ~100 ms of TTFT it
+        # did not earn.  Adopted executables make these compiles fleet-wide.
+        leader = next(iter(fleet.engines.values()))
+        for p in (warm_prompt, np.concatenate([warm_prompt, warm_tail])):
+            leader.add_request(p, max_new_tokens=max_new_tokens)
+            while leader.has_work:
+                leader.step()
+        fleet.warm()
+        for eng in fleet.engines.values():
+            eng.reset_counters()
+        fleet.start()
+        outs, plen = {}, {}
+        convs = {s: list(p) for s, p in prompts.items()}
+        t0 = time.perf_counter()
+        for t in range(1, turns + 1):
+            handles = {}
+            for s in sessions:
+                if t > 1:
+                    convs[s] = (convs[s] + list(outs[(s, t - 1)].token_ids)
+                                + chunks[(s, t)])
+                plen[(s, t)] = len(convs[s])
+                handles[s] = fleet.submit(np.asarray(convs[s], np.int32),
+                                          session=s,
+                                          max_new_tokens=max_new_tokens)
+            for s, h in handles.items():
+                out = fleet.result(h, timeout=300.0)
+                if out is None:
+                    raise RuntimeError(f"fleet bench: session {s} turn {t} "
+                                       f"timed out on {h}")
+                outs[(s, t)] = out
+        dt = time.perf_counter() - t0
+        if not fleet.drain(timeout=60.0):
+            raise RuntimeError("fleet bench: drain timed out")
+        fleet.check_invariants()
+        fstats = fleet.stats()
+        fleet.stop()
+        returning = [k for k in outs if k[1] >= 2]
+        ret_cached = sum(int(outs[k].cached_tokens) for k in returning)
+        ret_prompt = sum(plen[k] for k in returning)
+        ttfts = sorted(float(outs[k].ttft_s) for k in returning
+                       if outs[k].ttft_s is not None)
+        submitted = [d["submitted"] for d in fstats["per_engine"].values()]
+        return {
+            "digest": {f"{s}|{t}": [int(x) for x in o.token_ids]
+                       for (s, t), o in outs.items()},
+            "gen": sum(len(o.token_ids) for o in outs.values()),
+            "dt": dt,
+            "hit": ret_cached / max(ret_prompt, 1),
+            "ttft_p50_ms": median(ttfts) * 1e3 if ttfts else None,
+            "balance": round(min(submitted) / max(max(submitted), 1), 3),
+            "shed": fstats["shed"],
+            "shared": shared,
+        }
+
+    single = _pass(1, "affinity")
+    passes = {"affinity": _pass(replicas, "affinity"),
+              "round_robin": _pass(replicas, "round_robin")}
+    if router not in passes:
+        passes[router] = _pass(replicas, router)
+    req, aff, rr = passes[router], passes["affinity"], passes["round_robin"]
+    return {
+        "replicas": replicas,
+        "router": router,
+        "fleet_sessions": num_sessions,
+        "fleet_turns": turns,
+        "fleet_generated_tokens_per_sec": round(
+            req["gen"] / max(req["dt"], 1e-9), 2),
+        "replica_balance": req["balance"],
+        "fleet_shed": req["shed"],
+        "affinity_prefix_hit_rate": round(aff["hit"], 4),
+        "round_robin_prefix_hit_rate": round(rr["hit"], 4),
+        "affinity_prefix_hit_ratio": round(
+            (1.0 + aff["hit"]) / (1.0 + rr["hit"]), 4),
+        "affinity_returning_ttft_p50_ms": (
+            None if aff["ttft_p50_ms"] is None
+            else round(aff["ttft_p50_ms"], 2)),
+        "round_robin_returning_ttft_p50_ms": (
+            None if rr["ttft_p50_ms"] is None
+            else round(rr["ttft_p50_ms"], 2)),
+        "fleet_parity": all(p["digest"] == single["digest"]
+                            for p in passes.values()),
+        "fleet_shared_executables": single["shared"] and all(
+            p["shared"] for p in passes.values()),
+    }
+
+
 def main():
     import argparse
     import os
@@ -696,6 +880,21 @@ def main():
                          "spilled prefixes serialize here (npz per page) "
                          "instead of being dropped, and restore "
                          "transparently on a hit")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="dp engine-fleet width: > 1 adds the fleet passes "
+                         "(run_fleet_bench) — a multi-turn session stream "
+                         "routed through EngineFleet under --router, plus "
+                         "the round-robin cache-blind baseline and a "
+                         "single-engine parity oracle on the same stream; "
+                         "the row gains the fleet axes + "
+                         "affinity-vs-round-robin prefix-hit/TTFT A/B "
+                         "(CPU-smoke shaped on every platform)")
+    ap.add_argument("--router", choices=("affinity", "round_robin",
+                                         "least_loaded"),
+                    default="affinity",
+                    help="fleet routing policy for the requested pass; the "
+                         "affinity-vs-round-robin A/B always runs both "
+                         "sides regardless")
     ap.add_argument("--request-rate", type=float, default=None,
                     help="Poisson arrival rate in req/s (default: offline)")
     ap.add_argument("--no-request-tracing", action="store_true",
@@ -745,6 +944,8 @@ def main():
         ap.error("--mp must be >= 1")
     if args.oversubscribe < 0:
         ap.error("--oversubscribe must be >= 0")
+    if args.replicas < 1:
+        ap.error("--replicas must be >= 1")
     if args.prefill_chunk is not None and args.prefill_chunk != "auto":
         try:
             args.prefill_chunk = int(args.prefill_chunk)
@@ -926,6 +1127,15 @@ def main():
         stats["tracing_parity"] = all(
             r["outputs_digest"] == stats["outputs_digest"]
             for r in on_runs + off_runs)
+    # dp fleet axes ride on every row (schema v3); the fleet passes
+    # themselves run only when asked — run_fleet_bench replays ITS OWN
+    # pre-drawn multi-turn stream through a single-engine parity oracle,
+    # the requested-router fleet and the cache-blind round-robin baseline
+    stats["replicas"] = args.replicas
+    stats["router"] = args.router if args.replicas > 1 else None
+    if args.replicas > 1:
+        stats.update(run_fleet_bench(replicas=args.replicas,
+                                     router=args.router))
     # per-request streams fed the agreement score above; the digest already
     # fingerprints them, so keep the JSON line bounded
     stats.pop("output_tokens", None)

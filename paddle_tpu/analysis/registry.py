@@ -231,12 +231,14 @@ SERVE_SLO: Dict[str, object] = {
 # the bench (byte parity, dispatch counts, the stamp-count tracing account)
 # tightly and the wall-clock ratios loosely.
 SERVE_PERF_FLOORS: Dict[str, object] = {
-    "schema_version": 2,
+    "schema_version": 3,
     # every parity flag a bench run reports must be True — byte-exact greedy
     # parity is the one bar noise cannot excuse (kv_tier_parity: tier
-    # restores must be bit-exact vs the --no-kv-tier re-prefill)
+    # restores must be bit-exact vs the --no-kv-tier re-prefill;
+    # fleet_parity: routing a session stream across dp replicas must emit
+    # the same tokens as one engine serving it alone)
     "parity_flags": ("fuse_parity", "spec_parity", "oversubscribe_parity",
-                     "tracing_parity", "kv_tier_parity"),
+                     "tracing_parity", "kv_tier_parity", "fleet_parity"),
     # the one-dispatch claim in numbers: a fused busy step dispatches
     # exactly ONE decode-side program — tied to the program budget above so
     # the two guards cannot drift apart
@@ -265,6 +267,14 @@ SERVE_PERF_FLOORS: Dict[str, object] = {
     # CPU smoke sits ~0.7-0.85; token counts are scheduling-exact, so this
     # floor is noise-free)
     "returning_prefilled_drop_min": 0.5,
+    # the affinity-routing claim (dp fleet PR), deterministic on any
+    # `--replicas > 1` row: the returning-turn prefix-hit odds ratio
+    # (1 + affinity_hit) / (1 + round_robin_hit) on the identical session
+    # stream must be >= 1 — cache-aware routing never hits LESS than the
+    # cache-blind round-robin baseline (the measured CPU smoke sits ~1.45;
+    # hit rates are token-count-exact, so this floor is noise-free).  The
+    # TTFT side of the A/B is wall-clock and stays report-only.
+    "affinity_prefix_hit_ratio_min": 1.0,
 }
 
 

@@ -46,6 +46,20 @@ class Config:
         self._prefix = prog_file
         self._params_file = params_file
         self._flags: Dict[str, object] = {}
+        self._llm: Optional[Dict[str, object]] = None
+
+    def enable_llm_engine(self, model_config, params, *, replicas: int = 1,
+                          router: str = "affinity", **engine_kwargs):
+        """Route this Config to the continuous-batching causal-LM engine
+        instead of a saved StableHLO program: `create_predictor` then
+        returns an `LLMEngine` (or, with `replicas > 1`, an `EngineFleet`
+        routing across dp replicas — the serving front door's fleet).
+        `engine_kwargs` forward to `LLMEngine` verbatim (num_slots,
+        page_size, spec_len, kv_tier, ...)."""
+        self._llm = {"model_config": model_config, "params": params,
+                     "replicas": int(replicas), "router": router,
+                     "engine_kwargs": engine_kwargs}
+        return self
 
     def set_prog_file(self, path):
         self._prefix = path[:-len(".pdmodel")] if path.endswith(".pdmodel") \
@@ -172,8 +186,43 @@ class Predictor:
             return [self._outputs[n]._data for n in self._out_names]
 
 
-def create_predictor(config: Config) -> Predictor:
-    return Predictor(config)
+def _causal_lm_predictor(model_config, params, *, replicas: int = 1,
+                         router: str = "affinity", **engine_kwargs):
+    if params is None:
+        raise ValueError("causal-LM predictor needs params (the model's "
+                         "weight pytree)")
+    from .engine import LLMEngine
+    from .router import EngineFleet
+    if replicas > 1:
+        return EngineFleet(params, model_config, replicas=replicas,
+                           router=router, engine_kwargs=engine_kwargs)
+    return LLMEngine(params, model_config, **engine_kwargs)
+
+
+def create_predictor(config, params=None, **engine_kwargs):
+    """The ONE front door for inference construction (ref
+    `paddle_inference_api.create_predictor`), now routing by config kind:
+
+    - a `Config` naming a saved StableHLO program -> `Predictor` (the
+      classic named-handle path);
+    - a `Config` with `enable_llm_engine(...)` set, or a `models.gpt
+      .GPTConfig` passed directly with `params=` -> the continuous-batching
+      `LLMEngine`, or an `EngineFleet` of dp replicas when `replicas > 1`
+      (affinity-routed by default; serve it over HTTP with
+      `ServingFrontend`)."""
+    if isinstance(config, Config):
+        if config._llm is not None:
+            spec = config._llm
+            return _causal_lm_predictor(
+                spec["model_config"], spec["params"],
+                replicas=spec["replicas"], router=spec["router"],
+                **{**spec["engine_kwargs"], **engine_kwargs})
+        return Predictor(config)
+    # duck-typed causal-LM model config (models.gpt.GPTConfig and friends)
+    if hasattr(config, "num_layers") and hasattr(config, "vocab_size"):
+        return _causal_lm_predictor(config, params, **engine_kwargs)
+    raise TypeError(f"create_predictor: expected an inference.Config or a "
+                    f"causal-LM model config, got {type(config).__name__}")
 
 
 def get_version():
@@ -199,7 +248,11 @@ _SERVING = {"LLMEngine": "engine", "Request": "engine",
             "RateWindow": "metrics", "RATE_WINDOWS": "metrics",
             "RequestTrace": "tracing",
             "evaluate_engine_health": "health", "HEALTH_STATES": "health",
-            "ObservabilityServer": "obs_server"}
+            "ObservabilityServer": "obs_server",
+            "EngineFleet": "router", "FleetHandle": "router",
+            "FleetOverloaded": "router", "ReplicaView": "router",
+            "rank_replicas": "router", "ROUTER_POLICIES": "router",
+            "ServingFrontend": "frontend", "PRIORITY_CLASSES": "frontend"}
 
 
 def __getattr__(name):
@@ -217,4 +270,7 @@ __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "MetricsRegistry", "Counter", "Gauge", "Histogram",
            "log_buckets", "FleetMetrics", "RateWindow", "RATE_WINDOWS",
            "RequestTrace", "evaluate_engine_health", "HEALTH_STATES",
-           "ObservabilityServer"]
+           "ObservabilityServer",
+           "EngineFleet", "FleetHandle", "FleetOverloaded", "ReplicaView",
+           "rank_replicas", "ROUTER_POLICIES", "ServingFrontend",
+           "PRIORITY_CLASSES"]

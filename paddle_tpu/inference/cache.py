@@ -519,7 +519,7 @@ class PagedKVCache:
             self._tier.drop(node.node_id)
 
     def register_prefix(self, slot: int, tokens: np.ndarray,
-                        filled: int) -> None:
+                        filled: int, upgrade: bool = False) -> None:
         """Publish `slot`'s prompt pages whose KV is complete (the first
         `filled` of `tokens`) into the prefix index.  Idempotent — call after
         every prefill chunk; already-indexed keys (including pages this slot
@@ -528,7 +528,15 @@ class PagedKVCache:
         is registered only once the whole prompt is in (filled == len) — its
         content hash must cover exactly the prompt tail, and the slot keeps
         appending decode tokens past it (harmless: the node only ever claims
-        the first n_tokens of the page; COW borrowers overwrite the rest)."""
+        the first n_tokens of the page; COW borrowers overwrite the rest).
+
+        `upgrade=True` (finish-time registration of GENERATED pages): a page
+        this slot owns that is already claimed by a SHORTER partial node —
+        the prompt-time claim over the prompt's tail, which the slot has
+        since decoded past — is re-keyed in place to the longer content
+        (`_upgrade_node`), instead of stopping the walk at it.  Both claims
+        are true of the page's KV (the slot appended in place), so the
+        upgrade only widens what future prompts can match."""
         tokens = np.asarray(tokens, np.int32)
         page = self.page_size
         pages = self._used[slot]
@@ -536,11 +544,18 @@ class PagedKVCache:
         for i in range(min(filled, tokens.size) // page):
             key = (parent, tokens[i * page:(i + 1) * page].tobytes())
             node = self._index.get(key)
-            if node is None and pages[i] not in self._page_node:
-                node = _PrefixNode(next(self._node_ids), key, pages[i], page)
-                self._index[key] = node
-                self._page_node[pages[i]] = node
-                self._register_partial(node)
+            if node is None:
+                holder = self._page_node.get(pages[i])
+                if holder is None:
+                    node = _PrefixNode(next(self._node_ids), key, pages[i],
+                                       page)
+                    self._index[key] = node
+                    self._page_node[pages[i]] = node
+                    self._register_partial(node)
+                elif upgrade and holder.key[0] == parent and \
+                        holder.n_tokens < page and \
+                        key[1].startswith(holder.key[1]):
+                    node = self._upgrade_node(holder, key, page)
             if node is None:        # page already published under another key
                 return
             parent = node.node_id
@@ -548,11 +563,37 @@ class PagedKVCache:
         if rem and filled == tokens.size:
             i = tokens.size // page
             key = (parent, tokens[i * page:].tobytes())
-            if key not in self._index and pages[i] not in self._page_node:
+            if key in self._index:
+                return
+            holder = self._page_node.get(pages[i])
+            if holder is None:
                 node = _PrefixNode(next(self._node_ids), key, pages[i], rem)
                 self._index[key] = node
                 self._page_node[pages[i]] = node
                 self._register_partial(node)
+            elif upgrade and holder.key[0] == parent and \
+                    holder.n_tokens < rem and \
+                    key[1].startswith(holder.key[1]):
+                self._upgrade_node(holder, key, rem)
+
+    def _upgrade_node(self, node: _PrefixNode, key: Tuple[int, bytes],
+                      n_tokens: int) -> _PrefixNode:
+        """Re-key `node` to a LONGER claim over the same page (finish-time
+        registration: the owning slot decoded past the original claim, so
+        the page now holds more verified content).  Identity — node_id,
+        page, refcount/LRU state, trie children keyed by node_id — is
+        preserved; only the content key and the rolling-hash partial
+        entries move."""
+        del self._index[node.key]
+        for k in node.partial_keys:
+            if self._partial.get(k) is node:
+                del self._partial[k]
+        node.partial_keys = []
+        node.key = key
+        node.n_tokens = n_tokens
+        self._index[key] = node
+        self._register_partial(node)
+        return node
 
     def _evict(self, fresh_needed: int) -> None:
         """Reclaim LRU unreferenced cached prefixes until `fresh_needed`

@@ -188,6 +188,7 @@ import dataclasses
 import itertools
 import json
 import os
+import threading
 import time
 from collections import deque
 from typing import Callable, Dict, List, Optional
@@ -1063,6 +1064,16 @@ class LLMEngine:
         self._step_dispatches = 0
         self._step_sync_s = 0.0
         self._step_slots = {"decode": 0, "verify": 0, "chunk": 0}
+        # serving-loop surface (front door / fleet): the engine itself is
+        # single-threaded by design, so one RLock serializes the background
+        # step() loop against submit/cancel/probe/result callers; the
+        # condition (same lock) wakes the loop on intake and waiters on
+        # every step's outputs
+        self._serve_lock = threading.RLock()
+        self._serve_cond = threading.Condition(self._serve_lock)
+        self._serve_thread: Optional[threading.Thread] = None
+        self._serve_stop = False
+        self._serve_error: Optional[BaseException] = None
         self.reset_counters()
 
     def reset_counters(self) -> None:
@@ -2524,6 +2535,21 @@ class LLMEngine:
             reason = "length"
         if reason is None:
             return False
+        if self.prefix_cache:
+            # finish-time registration (tier follow-on): publish the
+            # GENERATED pages next to the prompt pages before the slot
+            # releases, so a returning session's last reply is a prefix hit
+            # (device trie or tier restore) instead of a full re-prefill.
+            # KV completeness bound: `cache.lengths[slot]` counts positions
+            # whose KV actually landed — (prompt ++ generated) minus the
+            # final sampled token, whose KV is never computed — so the
+            # registered content is exactly that written prefix, tail
+            # partial page included (filled == tokens.size).
+            kvlen = int(self.cache.lengths[seq.slot])
+            conv = np.concatenate([
+                np.asarray(seq.request.prompt, np.int32),
+                np.asarray(seq.generated, np.int32)])[:kvlen]
+            self.cache.register_prefix(seq.slot, conv, kvlen, upgrade=True)
         self.cache.release(seq.slot)
         self._free_slots.append(seq.slot)
         out = self._finish_output(seq.request, seq.generated, reason,
@@ -2642,6 +2668,181 @@ class LLMEngine:
     def has_work(self) -> bool:
         return bool(self._queue or self._running or self._prefilling or
                     self._inflight is not None or self._orphan_finished)
+
+    # ---- serving-loop surface (front door / fleet) ------------------------
+    # One replica = one LLMEngine + one background step() thread.  Every
+    # entry point below takes `_serve_lock`, so a fleet router (or the HTTP
+    # front door's event loop) can submit/stream/abort from any thread while
+    # the loop steps; the lock is re-entrant, so single-threaded callers
+    # (benches, tests) can keep driving step()/run() directly.
+
+    def start_loop(self, idle_wait_s: float = 0.002) -> None:
+        """Start the background step() loop (idempotent).  The loop parks on
+        the serve condition when idle — submit()/cancel() wake it — and
+        re-checks `has_work` every `idle_wait_s` as a fallback heartbeat."""
+        with self._serve_lock:
+            if self._serve_thread is not None and \
+                    self._serve_thread.is_alive():
+                return
+            self._serve_stop = False
+            self._serve_error = None
+            self._serve_thread = threading.Thread(
+                target=self._serve_loop, args=(float(idle_wait_s),),
+                name="llm-serve-loop", daemon=True)
+            self._serve_thread.start()
+
+    def stop_loop(self, timeout: float = 30.0) -> None:
+        """Stop the loop thread (idempotent; queued work stays queued —
+        call drain() first for a clean flush)."""
+        with self._serve_cond:
+            self._serve_stop = True
+            self._serve_cond.notify_all()
+        t = self._serve_thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+        self._serve_thread = None
+
+    @property
+    def loop_running(self) -> bool:
+        t = self._serve_thread
+        return t is not None and t.is_alive()
+
+    def _serve_loop(self, idle_wait_s: float) -> None:
+        while True:
+            with self._serve_cond:
+                if self._serve_stop:
+                    return
+                if not self.has_work:
+                    self._serve_cond.wait(idle_wait_s)
+                    continue
+                try:
+                    self.step()
+                except BaseException as exc:    # noqa: BLE001 — surfaced to
+                    self._serve_error = exc     # result()/drain() waiters
+                    self._serve_cond.notify_all()
+                    return
+                self._serve_cond.notify_all()
+
+    def _check_loop(self) -> None:
+        if self._serve_error is not None:
+            raise RuntimeError("serve loop died") from self._serve_error
+
+    def submit(self, prompt, **kwargs) -> int:
+        """Thread-safe add_request(): enqueue under the serve lock and wake
+        the loop.  Same signature/validation/rejection semantics."""
+        with self._serve_cond:
+            self._check_loop()
+            rid = self.add_request(prompt, **kwargs)
+            self._serve_cond.notify_all()
+            return rid
+
+    def cancel(self, request_id: int) -> bool:
+        """Thread-safe abort() (client disconnect propagation: frees the
+        request's pages immediately)."""
+        with self._serve_cond:
+            ok = self.abort(request_id)
+            if ok:
+                self._serve_cond.notify_all()
+            return ok
+
+    def progress(self, request_id: int) -> Dict[str, object]:
+        """Streaming snapshot: the tokens a request has produced so far and
+        whether it finished (`output` carries the final RequestOutput then).
+        Under double-buffering the snapshot may lag the device by one
+        in-flight step — exact at finish, which is what streaming needs."""
+        with self._serve_lock:
+            out = self._outputs.get(request_id)
+            if out is not None:
+                return {"known": True, "finished": True,
+                        "token_ids": list(out.token_ids), "output": out}
+            for seq in self._running.values():
+                if seq.request.request_id == request_id:
+                    return {"known": True, "finished": False,
+                            "token_ids": list(seq.generated), "output": None}
+            for st in self._prefilling.values():
+                if st.request.request_id == request_id:
+                    return {"known": True, "finished": False,
+                            "token_ids": list(st.prior or []), "output": None}
+            rec = self._preempted.get(request_id)
+            if rec is not None:
+                return {"known": True, "finished": False,
+                        "token_ids": list(rec.get("generated") or []),
+                        "output": None}
+            for req in self._queue:
+                if req.request_id == request_id:
+                    return {"known": True, "finished": False,
+                            "token_ids": [], "output": None}
+            return {"known": False, "finished": False,
+                    "token_ids": [], "output": None}
+
+    def result(self, request_id: int,
+               timeout: Optional[float] = None) -> Optional[RequestOutput]:
+        """Block until `request_id` finishes (or `timeout` elapses — then
+        None).  With the loop running this waits on its step notifications;
+        without it, the caller's own thread drives step() inline, so the
+        surface also works single-threaded."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._serve_cond:
+            while True:
+                out = self._outputs.get(request_id)
+                if out is not None:
+                    return out
+                self._check_loop()
+                if not self.loop_running:
+                    if not self.has_work:
+                        return None
+                    self.step()
+                    continue
+                rem = 0.5 if deadline is None \
+                    else deadline - time.monotonic()
+                if rem <= 0.0:
+                    return None
+                self._serve_cond.wait(rem)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Wait until the engine is fully idle (False on timeout)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._serve_cond:
+            while self.has_work:
+                self._check_loop()
+                if not self.loop_running:
+                    self.step()
+                    continue
+                rem = 0.5 if deadline is None \
+                    else deadline - time.monotonic()
+                if rem <= 0.0:
+                    return False
+                self._serve_cond.wait(rem)
+            return True
+
+    def queue_depth(self) -> int:
+        """Live request count (queued + prefilling + decoding) — the
+        router's load signal, cheap enough to read per routing decision."""
+        with self._serve_lock:
+            return (len(self._queue) + len(self._prefilling) +
+                    len(self._running))
+
+    def probe_affinity(self, tokens) -> Dict[str, int]:
+        """Router probe: longest cached prefix of `tokens` this replica
+        holds, split into total matched tokens and the portion that is
+        tier-resident (host/disk — a hit there restores via one scatter
+        instead of re-prefilling).  Pure read — no LRU touch, no COW, no
+        refcount; the admission-time `_match` in step() remains the only
+        mutating matcher."""
+        if not self.prefix_cache:
+            return {"cached_tokens": 0, "tier_tokens": 0}
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        with self._serve_lock:
+            full, partial = self.cache._match(tokens)
+        page = self.cache.page_size
+        matched = len(full) * page
+        tier = sum(page for n in full if n.page < 0)
+        if partial is not None:
+            node, j = partial
+            matched += j
+            if node.page < 0:
+                tier += j
+        return {"cached_tokens": int(matched), "tier_tokens": int(tier)}
 
     # ---- observability ----------------------------------------------------
     @contextlib.contextmanager

@@ -217,6 +217,60 @@ class ObservabilityServer:
         rep = one(self.engine)
         return _health_status(rep["state"]), rep
 
+    def dispatch(self, path: str, query: str = "", accept: str = "",
+                 extra_routes: tuple = ()):
+        """The ONE routing table, as data: ``(status, content_type,
+        body_bytes)`` for any GET path.  Both HTTP doors serve exactly this
+        — the stdlib handler below and the serving front door
+        (`inference.frontend`, which mounts the obs routes next to
+        ``/v1/*``) — so the two servers cannot drift.  Unknown paths 404
+        with the advertised route list (plus the caller's `extra_routes`,
+        e.g. the front door's inference endpoints)."""
+        def json_reply(obj, code=200):
+            return code, "application/json; charset=utf-8", \
+                json.dumps(obj).encode("utf-8")
+
+        path = path.rstrip("/") or "/"
+        if path == "/metrics":
+            om = "application/openmetrics-text" in (accept or "")
+            return (200,
+                    _OPENMETRICS_CONTENT_TYPE if om
+                    else _METRICS_CONTENT_TYPE,
+                    self.render_metrics(openmetrics=om).encode("utf-8"))
+        if path == "/stats":
+            return json_reply(self.render_stats())
+        if path == "/debug":
+            return json_reply(self.render_debug())
+        if path == "/healthz":
+            # routed through the real health evaluation (render_health
+            # never raises: an evaluation failure IS a 503 payload, not a
+            # generic 500 — and never a blind 200)
+            code, payload = self.render_health()
+            return json_reply(payload, code)
+        if path.startswith("/requests/"):
+            tail = path[len("/requests/"):]
+            try:
+                rid = int(tail)
+            except ValueError:
+                return json_reply({"error": f"bad request id {tail!r}"}, 400)
+            engine = (parse_qs(query).get("engine") or [None])[0]
+            status, payload = self.render_request(rid, engine)
+            if status == "not_found":
+                return json_reply(
+                    {"error": f"unknown request {rid} (tracing off, "
+                              f"never submitted, or not retained)"}, 404)
+            if status == "ambiguous":
+                return json_reply(
+                    {"error": f"request id {rid} exists on "
+                              f"{len(payload)} engines — request ids "
+                              f"are per-engine; scope the lookup",
+                     "engines": payload,
+                     "handles": [f"/requests/{rid}?engine={lb}"
+                                 for lb in payload]}, 300)
+            return json_reply(payload)
+        return json_reply({"error": f"no route {path!r}",
+                           "routes": list(ROUTES) + list(extra_routes)}, 404)
+
     def render_request(self, rid: int, engine: Optional[str] = None):
         """``(status, payload)`` for ``/requests/<rid>``: ``("ok", tree)``,
         ``("not_found", None)``, or — fleet mode only — ``("ambiguous",
@@ -257,54 +311,12 @@ def _make_handler(srv: ObservabilityServer):
 
         def do_GET(self):  # noqa: N802 (http.server API)
             path, _, query = self.path.partition("?")
-            path = path.rstrip("/") or "/"
             try:
-                if path == "/metrics":
-                    om = "application/openmetrics-text" in \
-                        self.headers.get("Accept", "")
-                    self._send(
-                        200, srv.render_metrics(openmetrics=om)
-                        .encode("utf-8"),
-                        _OPENMETRICS_CONTENT_TYPE if om
-                        else _METRICS_CONTENT_TYPE)
-                elif path == "/stats":
-                    self._send_json(srv.render_stats())
-                elif path == "/debug":
-                    self._send_json(srv.render_debug())
-                elif path == "/healthz":
-                    # routed through the real health evaluation (render_
-                    # health never raises: an evaluation failure IS a 503
-                    # payload, not a generic 500 — and never a blind 200)
-                    code, payload = srv.render_health()
-                    self._send_json(payload, code)
-                elif path.startswith("/requests/"):
-                    tail = path[len("/requests/"):]
-                    try:
-                        rid = int(tail)
-                    except ValueError:
-                        self._send_json(
-                            {"error": f"bad request id {tail!r}"}, 400)
-                        return
-                    engine = (parse_qs(query).get("engine") or [None])[0]
-                    status, payload = srv.render_request(rid, engine)
-                    if status == "not_found":
-                        self._send_json(
-                            {"error": f"unknown request {rid} (tracing off, "
-                                      f"never submitted, or not retained)"},
-                            404)
-                    elif status == "ambiguous":
-                        self._send_json(
-                            {"error": f"request id {rid} exists on "
-                                      f"{len(payload)} engines — request ids "
-                                      f"are per-engine; scope the lookup",
-                             "engines": payload,
-                             "handles": [f"/requests/{rid}?engine={lb}"
-                                         for lb in payload]}, 300)
-                    else:
-                        self._send_json(payload)
-                else:
-                    self._send_json({"error": f"no route {path!r}",
-                                     "routes": list(ROUTES)}, 404)
+                # the shared routing table (srv.dispatch) is the whole
+                # handler — the serving front door mounts the same calls
+                code, ctype, body = srv.dispatch(
+                    path, query, self.headers.get("Accept", ""))
+                self._send(code, body, ctype)
             except (BrokenPipeError, ConnectionResetError):
                 # client hung up mid-write (scrape timeout, curl Ctrl-C):
                 # nothing to send a response TO — just drop the connection

@@ -293,8 +293,8 @@ def test_bench_multi_turn_tier_acceptance(params, cfg):
     """CPU-smoke --multi-turn: returning-session prefilled tokens drop
     >= 50% and returning TTFT p50 improves vs --no-kv-tier on the same
     stream, with byte-exact greedy parity and zero new compiled programs
-    (decode-side 1, swap bucket <= 2) — and the v2 trajectory row built
-    from the run passes schema + floors."""
+    (decode-side 1, swap bucket <= 2) — and the current-schema trajectory
+    row built from the run passes schema + floors."""
     from bench_serve import run_serve_bench
     from tools.check_bench import bench_row, check_floors, validate_row
 
@@ -318,7 +318,7 @@ def test_bench_multi_turn_tier_acceptance(params, cfg):
         tier["outputs_digest"] == base["outputs_digest"]
     stats["returning_prefilled_drop"] = round(drop, 4)
     row = bench_row(stats)
-    assert row["schema_version"] == 2
+    assert row["schema_version"] == 3
     assert validate_row(row) == []
     assert check_floors(row) == []
     assert row["mode"]["kv_tier"] is True and row["mode"]["multi_turn"] == 3

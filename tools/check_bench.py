@@ -15,12 +15,15 @@ nothing noticed a regression until a human did.  This tool closes that gap:
   through `append_bench_row()` here, so the row shape and its validator
   live in one file.
 - **Floors** (`--ci`): runs a fresh CPU-smoke bench (subprocess, exactly
-  what a human would run) and enforces `SERVE_PERF_FLOORS` — declared ONCE
-  in `paddle_tpu/analysis/registry.py` next to the resource budgets: every
-  parity flag true, dispatches/step within the decode-side program budget,
-  fused_speedup over its floor, the deterministic tracing account under 2%,
-  model_error a sane positive ratio.  The passing row is appended, so a
-  green CI run IS a trajectory point.
+  what a human would run — `--replicas 2` so the dp-fleet passes run too)
+  and enforces `SERVE_PERF_FLOORS` — declared ONCE in
+  `paddle_tpu/analysis/registry.py` next to the resource budgets: every
+  parity flag true (fleet_parity included), dispatches/step within the
+  decode-side program budget, fused_speedup over its floor, the
+  deterministic tracing account under 2%, model_error a sane positive
+  ratio, and on fleet rows the affinity-vs-round-robin prefix-hit odds
+  ratio >= 1 with replicas sharing the leader's compiled programs.  The
+  passing row is appended, so a green CI run IS a trajectory point.
 
 Exits non-zero with a diff on violation.  Usage:
     JAX_PLATFORMS=cpu python tools/check_bench.py --ci      # bench + floors
@@ -39,7 +42,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_HISTORY = os.path.join(_REPO, "BENCH_SERVE.jsonl")
 
-ROW_SCHEMA_VERSION = 2
+ROW_SCHEMA_VERSION = 3
 
 # the axes that make rows comparable across PRs: two rows agree on "mode"
 # or their perf numbers are not the same experiment.  v1 rows (pre KV
@@ -48,7 +51,11 @@ MODE_AXES_V1 = ("mp", "fused", "spec_len", "prefill_chunk", "weight_dtype",
                 "kv_dtype", "oversubscribe", "preempt_mode", "admission",
                 "request_tracing")
 # v2 (KV tiering PR): the tier switch and the multi-turn session axes
-MODE_AXES = MODE_AXES_V1 + ("kv_tier", "multi_turn", "session_return_frac")
+MODE_AXES_V2 = MODE_AXES_V1 + ("kv_tier", "multi_turn",
+                               "session_return_frac")
+# v3 (serving front door PR): the dp fleet axes — replica count + routing
+# policy (router is null on single-engine rows)
+MODE_AXES = MODE_AXES_V2 + ("replicas", "router")
 # the perf surface a trajectory reader plots; absent-in-this-mode metrics
 # (e.g. goodput_ratio without --oversubscribe) ride as null
 PERF_KEYS_V1 = ("decode_tokens_per_sec_per_chip", "generated_tokens_per_sec",
@@ -64,16 +71,24 @@ PERF_KEYS_V1 = ("decode_tokens_per_sec_per_chip", "generated_tokens_per_sec",
 # v2: tier spill/restore traffic + the returning-session view the tier's
 # win is measured on (prefilled_tokens rides along so the drop is
 # recomputable from any two rows)
-PERF_KEYS = PERF_KEYS_V1 + (
+PERF_KEYS_V2 = PERF_KEYS_V1 + (
     "prefilled_tokens", "resume_hits", "resume_restored_tokens",
     "partial_page_hits", "returning_prefilled_tokens",
     "returning_prefilled_drop", "returning_ttft_p50_ms")
+# v3: the fleet surface — requested-router throughput/balance plus the
+# affinity-vs-round-robin A/B on the identical session stream
+PERF_KEYS = PERF_KEYS_V2 + (
+    "fleet_generated_tokens_per_sec", "replica_balance", "fleet_shed",
+    "affinity_prefix_hit_rate", "round_robin_prefix_hit_rate",
+    "affinity_prefix_hit_ratio", "affinity_returning_ttft_p50_ms",
+    "round_robin_returning_ttft_p50_ms", "fleet_shared_executables")
 PARITY_KEYS = ("fuse_parity", "spec_parity", "oversubscribe_parity",
-               "tracing_parity", "kv_tier_parity")
+               "tracing_parity", "kv_tier_parity", "fleet_parity")
 REQUIRED_ROW_KEYS = frozenset({"schema_version", "t", "mode", "perf",
                                "parity"})
 _AXES_BY_VERSION = {1: (MODE_AXES_V1, PERF_KEYS_V1),
-                    2: (MODE_AXES, PERF_KEYS)}
+                    2: (MODE_AXES_V2, PERF_KEYS_V2),
+                    3: (MODE_AXES, PERF_KEYS)}
 
 
 def bench_row(stats, t=None):
@@ -176,6 +191,20 @@ def check_floors(row, floors=None):
         errors.append(f"returning_prefilled_drop {drop} below the declared "
                       f"{drop_min} — returning sessions are re-prefilling "
                       f"KV the tier should have restored")
+    # affinity-routing floor: deterministic (token-count hit rates, not
+    # wall clock) on any row whose mode ran the fleet passes
+    ratio = perf.get("affinity_prefix_hit_ratio")
+    ratio_min = floors.get("affinity_prefix_hit_ratio_min")
+    if (mode.get("replicas") or 1) > 1 and ratio_min is not None:
+        if not isinstance(ratio, (int, float)) or ratio < ratio_min:
+            errors.append(f"affinity_prefix_hit_ratio {ratio!r} below the "
+                          f"declared {ratio_min} — affinity routing is "
+                          f"hitting the prefix cache no better than the "
+                          f"cache-blind round-robin baseline")
+        if perf.get("fleet_shared_executables") is not True:
+            errors.append("fleet_shared_executables is not True — dp "
+                          "replicas stopped adopting the leader's compiled "
+                          "programs (replication must add zero executables)")
     return errors
 
 
@@ -223,7 +252,7 @@ def run_ci_bench():
     env.setdefault("JAX_PLATFORMS", "cpu")
     proc = subprocess.run(
         [sys.executable, os.path.join(_REPO, "bench_serve.py"),
-         "--no-history"],
+         "--no-history", "--replicas", "2"],
         capture_output=True, text=True, cwd=_REPO, env=env, timeout=1800)
     if proc.returncode != 0:
         raise RuntimeError(f"bench_serve.py failed (rc={proc.returncode}):\n"

@@ -36,6 +36,14 @@ or make a "counter" go backwards:
   the REAL health evaluation (structured state + per-signal detail, 200 for
   ok/degraded, 503 for overloaded — never the old hardcoded stub); and the
   `engine_health` gauge fleet-merges WORST-OF (max), not sum;
+- **front-door smoke** — the serving front door (`inference.frontend
+  .ServingFrontend`) over a 2-replica dp `EngineFleet` on a real loopback
+  socket: the obs routes served THROUGH the door (one server, `/v1/*` next
+  to `/metrics`) carry the fleet exposition — per-``{engine=...}`` series
+  for every replica plus `llm_fleet_*` merged totals equal to the member
+  sums — `/stats` is the per-label map, `/healthz` is the worst-of fleet
+  rollup (503 the moment any member reads overloaded), and the 404 route
+  list advertises the inference endpoints;
 - **monotonicity** — across a CPU-smoke engine loop that exercises admission,
   chunked prefill, speculative verify, prefix hits, LRU eviction AND abort,
   no counter ever decreases between steps;
@@ -528,6 +536,135 @@ def check_obs_server(eng, rid, errors):
             errors.append("/healthz carries no per-signal detail")
 
 
+def check_front_door(errors):
+    """ONE door: a 2-replica dp fleet served by `ServingFrontend`, with the
+    obs plane mounted on the same socket as `/v1/*`.  Asserts the door's
+    `/metrics` is the FLEET exposition (per-engine series + `llm_fleet_*`
+    merges equal to member sums), `/stats` maps per label, `/healthz` is
+    the worst-of rollup (flips to 503 when one member goes overloaded),
+    inference requests round-trip 200, and the 404 route list advertises
+    the `/v1` endpoints next to the obs routes."""
+    import urllib.error
+    import urllib.request
+
+    import jax
+    import numpy as np
+
+    from paddle_tpu.inference.frontend import ServingFrontend
+    from paddle_tpu.inference.router import EngineFleet
+    from paddle_tpu.models import gpt as G
+
+    def get(url, accept=None):
+        req = urllib.request.Request(
+            url, headers={"Accept": accept} if accept else {})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status, r.read().decode("utf-8")
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode("utf-8")
+
+    cfg = G.gpt_tiny(64)
+    params = G.init_params(cfg, jax.random.key(1))
+    fleet = EngineFleet(params, cfg, replicas=2,
+                        engine_kwargs=dict(num_slots=2, page_size=8,
+                                           max_model_len=64,
+                                           prefill_chunk=16, seed=0))
+    if not fleet.shared_executables():
+        errors.append("front-door fleet replicas did not adopt the "
+                      "leader's compiled executables")
+    fleet.start()
+    door = ServingFrontend(fleet).start()
+    try:
+        # land one request on EACH replica (round-robin) so every per-engine
+        # series carries real traffic, then one through the HTTP door itself
+        rng = np.random.RandomState(3)
+        for label in fleet.engines:
+            h = fleet.submit(rng.randint(0, cfg.vocab_size, (12,)),
+                             session=label, policy="round_robin",
+                             max_new_tokens=3)
+            if fleet.result(h, timeout=60.0) is None:
+                errors.append(f"front-door warm request on {label} "
+                              f"timed out")
+        body = json.dumps({
+            "prompt": [int(x) for x in rng.randint(0, cfg.vocab_size, (8,))],
+            "max_tokens": 3}).encode("utf-8")
+        req = urllib.request.Request(
+            door.url + "/v1/completions", data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                out = json.loads(r.read())
+                if not out.get("choices", [{}])[0].get("token_ids"):
+                    errors.append(f"front-door completion carried no "
+                                  f"tokens: {out}")
+        except urllib.error.HTTPError as e:
+            errors.append(f"POST /v1/completions through the door -> "
+                          f"{e.code}: {e.read()[:200]}")
+
+        # /metrics THROUGH the door == the fleet exposition
+        status, text = get(door.url + "/metrics")
+        if status != 200:
+            errors.append(f"front-door /metrics -> {status}")
+        check_exposition(text, errors)
+        try:
+            samples = parse_prometheus(text)
+        except ValueError as e:
+            errors.append(f"front-door /metrics failed to parse: {e}")
+            samples = {}
+        per = {parse_labels(lbl).get("engine"): v for lbl, v in
+               samples.get("llm_engine_decode_tokens_total", ())}
+        if set(per) != set(fleet.engines):
+            errors.append(f"front-door /metrics per-engine series "
+                          f"{sorted(per)} != replicas "
+                          f"{sorted(fleet.engines)}")
+        elif min(per.values()) <= 0:
+            errors.append(f"a replica served traffic but its per-engine "
+                          f"decode_tokens series is empty: {per}")
+        total = samples.get("llm_fleet_decode_tokens_total",
+                            [("", -1)])[0][1]
+        if total != sum(per.values()):
+            errors.append(f"front-door llm_fleet_decode_tokens_total "
+                          f"{total} != member sum {sum(per.values())}")
+
+        status, text = get(door.url + "/stats")
+        st = json.loads(text) if status == 200 else {}
+        if status != 200 or set(st) != set(fleet.engines):
+            errors.append(f"front-door /stats -> {status}, labels "
+                          f"{sorted(st)}")
+        status, text = get(door.url + "/healthz")
+        health = json.loads(text)
+        if status != 200 or health.get("state") not in HEALTH_STATES or \
+                set(health.get("engines", {})) != set(fleet.engines):
+            errors.append(f"front-door /healthz -> {status}: {health}")
+        # worst-of: wedge ONE member into overloaded — the fleet rollup
+        # must flip to 503/overloaded while the other member stays ok
+        eng1 = fleet.engines["engine1"]
+        real_health = eng1.health
+        eng1.health = lambda: {"state": "overloaded", "code": 2,
+                               "reasons": ["forced by check_metrics"],
+                               "signals": {}, "burn_rates": {}}
+        try:
+            status, text = get(door.url + "/healthz")
+            health = json.loads(text)
+            if status != 503 or health.get("state") != "overloaded":
+                errors.append(f"front-door /healthz is not worst-of: one "
+                              f"overloaded member -> {status} "
+                              f"{health.get('state')!r} (want 503 "
+                              f"overloaded)")
+        finally:
+            eng1.health = real_health
+
+        status, text = get(door.url + "/no-such-route")
+        routes = json.loads(text).get("routes", []) if status == 404 else []
+        if status != 404 or "POST /v1/completions" not in routes or \
+                "/metrics" not in routes:
+            errors.append(f"front-door 404 route list does not advertise "
+                          f"both planes: {status} {routes}")
+    finally:
+        door.close()
+        fleet.stop()
+
+
 def main() -> int:
     errors = []
     eng, st = run_smoke(errors)
@@ -581,6 +718,7 @@ def main() -> int:
     rid = check_exemplar_roundtrip(eng, errors)
     check_merge_and_fleet(eng, errors)
     check_obs_server(eng, rid, errors)
+    check_front_door(errors)
 
     # observability must be free of compiled programs: decode-side budget
     # unchanged — the bound comes from the registry (declared ONCE) so this

@@ -26,6 +26,13 @@ distinct-program counts, not dispatch-cache sizes.  (`--no-fuse` serving is
 the A/B escape hatch and sits outside this budget — it is still audited by
 tpu_lint's jaxpr level.)
 
+A third pass measures a 2-replica dp `EngineFleet` (the serving front
+door's scale-out unit): replication must ADD ZERO programs — replicas run
+on the leader's mesh and adopt its compiled executables, so every
+replica's counts stay inside the SAME single-engine budget and the
+executable objects are asserted literally identical
+(`EngineFleet.shared_executables`), not merely equal in number.
+
 Runs the bench_serve CPU smoke (chunked prefill + prefix cache + speculative
 decoding — every lane the scheduler can dispatch) and exits non-zero with a
 diff against the budget on violation.
@@ -81,6 +88,58 @@ def measure(mp=1):
     return got, stats
 
 
+def measure_fleet(replicas=2):
+    """dp replication adds ZERO programs: a 2-replica `EngineFleet` serving
+    a mixed stream (chunked prefill + prefix hits + spec decode, spread
+    round-robin so BOTH replicas dispatch) must keep every replica's
+    executable counts inside the single-engine budget, with the executable
+    objects literally shared (leader-adoption, same mesh).  Returns
+    ({label: counts}, shared_executables)."""
+    import jax
+    import numpy as np
+
+    from paddle_tpu.inference.router import EngineFleet
+    from paddle_tpu.models import gpt as G
+
+    cfg = G.gpt_tiny(64)
+    params = G.init_params(cfg, jax.random.key(11))
+    fleet = EngineFleet(params, cfg, replicas=replicas,
+                        engine_kwargs=dict(num_slots=2, page_size=8,
+                                           max_model_len=64,
+                                           prefill_chunk=16, spec_len=4,
+                                           seed=11))
+    fleet.warm()
+    rng = np.random.RandomState(11)
+    shared_prefix = rng.randint(0, cfg.vocab_size, (20,)).astype(np.int32)
+    prompts = [shared_prefix,
+               rng.randint(0, cfg.vocab_size, (9,)).astype(np.int32),
+               np.concatenate([shared_prefix,
+                               rng.randint(0, cfg.vocab_size,
+                                           (7,)).astype(np.int32)]),
+               rng.randint(0, cfg.vocab_size, (33,)).astype(np.int32)]
+    with fleet:
+        handles = [fleet.submit(p, session=f"s{i}", policy="round_robin",
+                                max_new_tokens=6)
+                   for i, p in enumerate(prompts)]
+        for h in handles:
+            if fleet.result(h, timeout=120.0) is None:
+                raise RuntimeError(f"fleet program-count stream timed out "
+                                   f"on {h}")
+    per = {}
+    for label, eng in fleet.engines.items():
+        st = eng.stats()
+        got = {
+            "decode_side_executables": st["decode_executables"] +
+                                       st["verify_executables"],
+            "prefill_executables": st["prefill_executables"],
+            "copy_executables": st["copy_executables"],
+            "swap_executables": st["swap_executables"],
+        }
+        got["total_executables"] = sum(got.values())
+        per[label] = got
+    return per, fleet.shared_executables()
+
+
 def main() -> int:
     rc = 0
     report = {"metric": "serve_compiled_program_count", "ok": True}
@@ -108,6 +167,27 @@ def main() -> int:
         rc = 1
         print("FAIL: mp=2 serving outputs diverge from single-chip (greedy "
               "token parity broken)", file=sys.stderr)
+    # dp fleet pass: replication shares the leader's compiled set — every
+    # replica inside the SAME single-engine budget, executables identical
+    fleet_per, fleet_shared = measure_fleet()
+    report["fleet"] = {"replicas": len(fleet_per), "budget": BUDGET,
+                       "shared_executables": fleet_shared,
+                       "per_replica": fleet_per, "ok": fleet_shared}
+    if not fleet_shared:
+        report["ok"] = False
+        rc = 1
+        print("FAIL[fleet]: replicas are not sharing the leader's compiled "
+              "executables — dp replication is minting duplicate programs",
+              file=sys.stderr)
+    for label, got in fleet_per.items():
+        over = {k: (got[k], BUDGET[k]) for k in BUDGET if got[k] > BUDGET[k]}
+        if over:
+            report["ok"] = report["fleet"]["ok"] = False
+            rc = 1
+            for k, (g, b) in over.items():
+                print(f"FAIL[fleet/{label}]: {k} = {g} exceeds documented "
+                      f"budget {b} — dp replication must not widen the "
+                      f"per-replica program set", file=sys.stderr)
     print(json.dumps(report))
     return rc
 
