@@ -794,6 +794,186 @@ def run_fleet_bench(*, replicas=2, router="affinity", num_sessions=5,
     }
 
 
+def run_disagg_bench(*, roles="P:D", num_sessions=4, turns=2,
+                     max_new_tokens=5, seed=0, config=None, params=None,
+                     num_slots=4, page_size=8, prefill_chunk=16):
+    """Disaggregated prefill/decode serving — the `--disagg P:D` axis.
+
+    Replays ONE pre-drawn multi-turn session stream through three setups
+    and one restart scenario (CPU-smoke shaped on every platform — the
+    claims under test are handoff correctness and latency, not device
+    throughput):
+
+    - a single-engine oracle (the parity baseline);
+    - a colocated 2-replica affinity fleet (what PR 16 ships) — its decode
+      TPOT carries the prefill interference a role split removes;
+    - a `roles`-partitioned disaggregated fleet: prefill replicas export
+      finished prompts through the shared durable tier store, decode
+      replicas one-scatter restore them (`handoff_p50/p99_ms` measure
+      prefill-submit -> decode-index-refresh wall time);
+    - an engine RESTART: engine A serves turn 1 on a private `spill_dir`,
+      exports, and is destroyed; a fresh engine B on the SAME dir re-
+      attaches the serialized index at construction and serves the
+      returning turn (`restart_restored_tokens` — tokens tier-restored
+      instead of re-prefilled — and `restart_ttft_ms`).
+
+    `disagg_parity` is byte-exact: colocated, disaggregated AND the
+    restarted engine's returning turn must all reproduce the oracle's
+    token streams.  `interference_tpot_delta_ms` (colocated decode-TPOT
+    p50 minus the disagg decode pool's) is report-only — wall clock on a
+    shared box."""
+    import tempfile
+
+    import jax
+
+    from paddle_tpu.inference.engine import LLMEngine
+    from paddle_tpu.inference.router import EngineFleet
+    from paddle_tpu.models import gpt as gpt_mod
+
+    if turns < 2:
+        raise ValueError(f"disagg bench needs returning turns (turns >= 2), "
+                         f"got {turns}")
+    if config is None:
+        config = gpt_mod.gpt_tiny(64)
+    if params is None:
+        params = gpt_mod.init_params(config, jax.random.key(seed))
+    max_model_len = config.max_seq_len
+    ekw = dict(num_slots=num_slots, page_size=page_size,
+               max_model_len=max_model_len, prefill_chunk=prefill_chunk,
+               spec_len=0, seed=seed)
+
+    rng = np.random.RandomState(seed)
+    user_chunk = max(2, page_size // 2)
+    reserve = (turns - 1) * (max_new_tokens + user_chunk) + max_new_tokens
+    first_max = max_model_len - reserve
+    if first_max <= page_size:
+        raise ValueError(f"turns={turns} leaves only {first_max} first-turn "
+                         f"prompt tokens at max_model_len={max_model_len}")
+    sessions = [f"s{i}" for i in range(num_sessions)]
+    prompts = {s: rng.randint(0, config.vocab_size,
+                              (int(rng.randint(page_size, first_max + 1)),)
+                              ).astype(np.int32).tolist()
+               for s in sessions}
+    chunks = {(s, t): rng.randint(0, config.vocab_size, (user_chunk,)
+                                  ).astype(np.int32).tolist()
+              for s in sessions for t in range(2, turns + 1)}
+    warm_rng = np.random.RandomState(seed + 1)
+    warm_prompt = warm_rng.randint(0, config.vocab_size,
+                                   (2 * page_size + 3,)).astype(np.int32)
+    warm_tail = warm_rng.randint(0, config.vocab_size,
+                                 (user_chunk + max_new_tokens,)
+                                 ).astype(np.int32)
+
+    def _warm(fleet):
+        leader = next(iter(fleet.engines.values()))
+        for p in (warm_prompt, np.concatenate([warm_prompt, warm_tail])):
+            leader.add_request(p, max_new_tokens=max_new_tokens)
+            while leader.has_work:
+                leader.step()
+        fleet.warm()
+        for eng in fleet.engines.values():
+            eng.reset_counters()
+
+    def _pass(fleet):
+        """Replay the stream through `fleet`; returns digest + decode-side
+        TPOT p50 (ms) + the fleet's own disagg/handoff stats."""
+        _warm(fleet)
+        fleet.start()
+        outs = {}
+        convs = {s: list(p) for s, p in prompts.items()}
+        for t in range(1, turns + 1):
+            handles = {}
+            for s in sessions:
+                if t > 1:
+                    convs[s] = (convs[s] + list(outs[(s, t - 1)].token_ids)
+                                + chunks[(s, t)])
+                handles[s] = fleet.submit(np.asarray(convs[s], np.int32),
+                                          session=s,
+                                          max_new_tokens=max_new_tokens)
+            for s, h in handles.items():
+                out = fleet.result(h, timeout=300.0)
+                if out is None:
+                    raise RuntimeError(f"disagg bench: session {s} turn {t} "
+                                       f"timed out on {h}")
+                outs[(s, t)] = out
+        if not fleet.drain(timeout=60.0):
+            raise RuntimeError("disagg bench: drain timed out")
+        fleet.check_invariants()
+        fstats = fleet.stats()
+        fleet.stop()
+        # decode-side TPOT: the decode pool's histograms under roles, every
+        # replica's otherwise (colocated replicas all decode)
+        dec = fleet.decode_pool or list(fleet.engines)
+        tpots = [fleet.engines[l]._h_tpot for l in dec
+                 if fleet.engines[l]._h_tpot.count]
+        tpot_ms = (median([h.percentile(50.0) for h in tpots]) * 1e3
+                   if tpots else None)
+        return {
+            "digest": {f"{s}|{t}": [int(x) for x in o.token_ids]
+                       for (s, t), o in outs.items()},
+            "tpot_p50_ms": tpot_ms,
+            "disagg": fstats.get("disagg"),
+        }
+
+    oracle = _pass(EngineFleet(params, config, replicas=1,
+                               engine_kwargs=dict(ekw)))
+    coloc = _pass(EngineFleet(params, config, replicas=2, router="affinity",
+                              engine_kwargs=dict(ekw)))
+    disagg = _pass(EngineFleet(params, config, roles=roles,
+                               engine_kwargs=dict(ekw)))
+
+    # ---- engine restart: sessions must outlive a process ------------------
+    spill_dir = tempfile.mkdtemp(prefix="kvrestart_")
+    s0 = sessions[0]
+    eng_a = LLMEngine(params, config, spill_dir=spill_dir, **ekw)
+    conv = list(prompts[s0])
+    out1 = eng_a.result(eng_a.add_request(np.asarray(conv, np.int32),
+                                          max_new_tokens=max_new_tokens))
+    conv = conv + [int(x) for x in out1.token_ids]
+    eng_a.export_prefix(np.asarray(conv, np.int32))
+    del eng_a
+    # a FRESH engine on the same spill_dir re-attaches the serialized index
+    # at construction — the returning turn restores with one scatter
+    eng_b = LLMEngine(params, config, spill_dir=spill_dir, **ekw)
+    # warm B's executables on throwaway prompts so restart_ttft_ms prices
+    # the restore path, not the restarted process's cold compiles
+    for p in (warm_prompt, np.concatenate([warm_prompt, warm_tail])):
+        eng_b.result(eng_b.add_request(p, max_new_tokens=max_new_tokens))
+    eng_b.warm_swap()
+    eng_b.reset_counters()
+    conv2 = conv + chunks[(s0, 2)]
+    out2 = eng_b.result(eng_b.add_request(np.asarray(conv2, np.int32),
+                                          max_new_tokens=max_new_tokens))
+    bst = eng_b.stats()
+    restart_ok = ([int(x) for x in out1.token_ids] == oracle["digest"][
+                      f"{s0}|1"] and
+                  [int(x) for x in out2.token_ids] == oracle["digest"][
+                      f"{s0}|2"])
+    del eng_b
+
+    dstats = disagg["disagg"] or {}
+    delta = (None if coloc["tpot_p50_ms"] is None or
+             disagg["tpot_p50_ms"] is None
+             else round((coloc["tpot_p50_ms"] - disagg["tpot_p50_ms"]), 3))
+    return {
+        "handoff_p50_ms": dstats.get("handoff_p50_ms"),
+        "handoff_p99_ms": dstats.get("handoff_p99_ms"),
+        "handoff_count": dstats.get("handoffs", 0),
+        "handoff_skips": dstats.get("handoff_skips", 0),
+        "handoff_degrades": dstats.get("handoff_degrades", 0),
+        "colocated_tpot_p50_ms": coloc["tpot_p50_ms"],
+        "disagg_tpot_p50_ms": disagg["tpot_p50_ms"],
+        "interference_tpot_delta_ms": delta,
+        "restart_restored_tokens": int(
+            bst["kv_tier"]["restored_tokens"]),
+        "restart_ttft_ms": (None if out2.ttft_s is None
+                            else round(float(out2.ttft_s) * 1e3, 2)),
+        "disagg_parity": (coloc["digest"] == oracle["digest"] and
+                          disagg["digest"] == oracle["digest"] and
+                          restart_ok),
+    }
+
+
 def main():
     import argparse
     import os
@@ -895,6 +1075,15 @@ def main():
                     help="fleet routing policy for the requested pass; the "
                          "affinity-vs-round-robin A/B always runs both "
                          "sides regardless")
+    ap.add_argument("--disagg", type=str, default=None, metavar="P:D",
+                    help="disaggregated prefill/decode passes "
+                         "(run_disagg_bench) under this role split (e.g. "
+                         "'P:D', '2P:2D'): the same pre-drawn multi-turn "
+                         "stream runs colocated vs disaggregated vs a "
+                         "single-engine oracle (byte-exact disagg_parity), "
+                         "plus an engine-restart restore sub-pass; the row "
+                         "gains handoff p50/p99, the prefill-interference "
+                         "TPOT delta and the restart axes")
     ap.add_argument("--request-rate", type=float, default=None,
                     help="Poisson arrival rate in req/s (default: offline)")
     ap.add_argument("--no-request-tracing", action="store_true",
@@ -1136,6 +1325,12 @@ def main():
     if args.replicas > 1:
         stats.update(run_fleet_bench(replicas=args.replicas,
                                      router=args.router))
+    # disaggregated prefill/decode axes (schema v4): role split + restart
+    # restore sub-pass; both null on non-disagg rows
+    stats["disagg"] = args.disagg
+    stats["restart"] = True if args.disagg else None
+    if args.disagg:
+        stats.update(run_disagg_bench(roles=args.disagg))
     # per-request streams fed the agreement score above; the digest already
     # fingerprints them, so keep the JSON line bounded
     stats.pop("output_tokens", None)

@@ -231,14 +231,17 @@ SERVE_SLO: Dict[str, object] = {
 # the bench (byte parity, dispatch counts, the stamp-count tracing account)
 # tightly and the wall-clock ratios loosely.
 SERVE_PERF_FLOORS: Dict[str, object] = {
-    "schema_version": 3,
+    "schema_version": 4,
     # every parity flag a bench run reports must be True — byte-exact greedy
     # parity is the one bar noise cannot excuse (kv_tier_parity: tier
     # restores must be bit-exact vs the --no-kv-tier re-prefill;
     # fleet_parity: routing a session stream across dp replicas must emit
-    # the same tokens as one engine serving it alone)
+    # the same tokens as one engine serving it alone; disagg_parity: the
+    # prefill->store->decode handoff AND the engine-restart restore must
+    # both reproduce the colocated single-engine stream byte-for-byte)
     "parity_flags": ("fuse_parity", "spec_parity", "oversubscribe_parity",
-                     "tracing_parity", "kv_tier_parity", "fleet_parity"),
+                     "tracing_parity", "kv_tier_parity", "fleet_parity",
+                     "disagg_parity"),
     # the one-dispatch claim in numbers: a fused busy step dispatches
     # exactly ONE decode-side program — tied to the program budget above so
     # the two guards cannot drift apart
@@ -275,6 +278,13 @@ SERVE_PERF_FLOORS: Dict[str, object] = {
     # hit rates are token-count-exact, so this floor is noise-free).  The
     # TTFT side of the A/B is wall-clock and stays report-only.
     "affinity_prefix_hit_ratio_min": 1.0,
+    # the disaggregation handoff ceiling (disagg rows): p99 wall latency of
+    # a prefill->store->decode handoff (prefill submit through decode index
+    # refresh).  Wall-clock on a shared CPU smoke, so the ceiling is set to
+    # catch only a collapse (a handoff path that re-prefills, blocks on a
+    # lock, or re-reads the whole store); measured CPU-smoke handoffs sit
+    # in the tens of ms.  disagg_parity carries the deterministic side.
+    "handoff_p99_ms_max": 5000.0,
 }
 
 

@@ -57,14 +57,28 @@ conversation KV restores with one h2d scatter instead of a full re-prefill.
 The host tier shares the engine's unified host-pool page budget with
 preemption swap parking (`host_pool_room`); over budget it cascades to a
 disk tier (`spill_dir=`) or drops, oldest first.
+
+Durable tier index + PageStore (disaggregated serving PR): the disk level
+writes through an object-store-shaped `PageStore` (`LocalDirStore` under
+`spill_dir` by default), and `save_tier_index` / `load_tier_index`
+serialize the trie + rolling-hash index beside the page objects
+(versioned, atomic-rename writes) — so a restarted, or DIFFERENT, process
+re-attaches any published session and restores it through the same
+one-scatter path.  That transport is exactly the prefill->decode handoff
+seam: a prefill-role engine exports its finished prompt's pages + index
+into the shared store, and any decode-role replica's admission finds and
+restores them.  A corrupted, version-skewed, or partially-deleted store
+can only cost a re-prefill, never a crash or a wrong match (token content
+rides in the index and every hash hit is verified against it).
 """
 from __future__ import annotations
 
 import dataclasses
 import itertools
+import json
 import os
 from collections import OrderedDict
-from typing import Callable, Dict, List, Optional, Set, Tuple
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
 
 import numpy as np
 
@@ -105,6 +119,103 @@ _HASH_MOD = (1 << 61) - 1
 # admission) to tax the dispatch account with worthless hits
 _MIN_PARTIAL = 2
 
+# serialized tier-index format version: `load_tier_index` only merges index
+# blobs whose version AND page geometry match — anything else is ignored and
+# the affected sessions degrade to re-prefill (never a crash)
+TIER_INDEX_VERSION = 1
+
+# distinguishes page objects written by different tiers sharing one store
+# (a disagg fleet's prefill + decode engines, or successive processes over
+# one spill_dir): node ids are only unique per process, store names must be
+# unique per writer
+_TIER_TAGS = itertools.count()
+
+
+class PageStore:
+    """Object-store-shaped durable level under the host KV tier.
+
+    The tier addresses content by NAME — ``kvnode_<tag>_<id>`` for page
+    slabs, ``kvindex_<tag>`` for serialized index blobs — and a store maps
+    names to bytes.  `LocalDirStore` below is the default; an S3/GCS-shaped
+    backend only has to implement these six methods, because the tier, the
+    durable index, and the cross-engine handoff never touch the filesystem
+    directly."""
+
+    def put(self, name: str, data: Dict[str, np.ndarray]) -> None:
+        """Store one page slab ({lane name: array}) under `name`."""
+        raise NotImplementedError
+
+    def get(self, name: str) -> Dict[str, np.ndarray]:
+        """Load a page slab; KeyError-family exceptions degrade upstream."""
+        raise NotImplementedError
+
+    def delete(self, name: str) -> None:
+        raise NotImplementedError
+
+    def exists(self, name: str) -> bool:
+        raise NotImplementedError
+
+    def put_blob(self, name: str, payload: bytes) -> None:
+        """Store an opaque blob (index files); must be atomic — a reader
+        may never observe a torn write."""
+        raise NotImplementedError
+
+    def blobs(self, prefix: str) -> Iterable[Tuple[str, bytes]]:
+        """Iterate (name, payload) over stored blobs under `prefix`."""
+        raise NotImplementedError
+
+
+class LocalDirStore(PageStore):
+    """The default `PageStore`: one npz file per page slab plus
+    atomically-renamed index blobs, all under one directory (the engine's
+    `spill_dir`) — the PR-15 disk-tier layout, now behind the store
+    interface so any replica (or a restarted process) can read it."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.root, name)
+
+    def put(self, name: str, data: Dict[str, np.ndarray]) -> None:
+        np.savez(self._path(name + ".npz"), **data)
+
+    def get(self, name: str) -> Dict[str, np.ndarray]:
+        with np.load(self._path(name + ".npz")) as z:
+            return {k: z[k] for k in z.files}
+
+    def delete(self, name: str) -> None:
+        path = self._path(name + ".npz")
+        if os.path.exists(path):
+            os.remove(path)
+
+    def exists(self, name: str) -> bool:
+        return os.path.exists(self._path(name + ".npz"))
+
+    def put_blob(self, name: str, payload: bytes) -> None:
+        # tmp-write + atomic rename: a concurrent reader (another replica's
+        # merge, a restarting process) sees the old blob or the new one,
+        # never a torn one
+        path, tmp = self._path(name), self._path(name + ".tmp")
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+
+    def blobs(self, prefix: str) -> Iterable[Tuple[str, bytes]]:
+        try:
+            names = sorted(os.listdir(self.root))
+        except OSError:
+            return
+        for fn in names:
+            if not fn.startswith(prefix) or fn.endswith(".tmp"):
+                continue
+            try:
+                with open(self._path(fn), "rb") as f:
+                    yield fn, f.read()
+            except OSError:
+                continue
+
 
 class HostKVTier:
     """Bounded host-side storage for spilled prefix-page KV, with an optional
@@ -122,13 +233,25 @@ class HostKVTier:
     _PENDING = object()
 
     def __init__(self, spill_dir: Optional[str] = None,
-                 disk_pages: Optional[int] = None):
+                 disk_pages: Optional[int] = None,
+                 store: Optional[PageStore] = None):
         self._host: "OrderedDict[int, object]" = OrderedDict()
+        # durable level: node id -> store name.  _shared marks entries whose
+        # store object is visible to OTHER readers — imported from another
+        # writer's index, or published in ours via `mark_shared` — so local
+        # pop/drop remove the entry without deleting the object (a replica
+        # restoring a handoff must not destroy the store under its peers;
+        # object garbage collection is a store-level concern).
         self._disk: "OrderedDict[int, str]" = OrderedDict()
+        self._shared: Set[int] = set()
         self.spill_dir = spill_dir
         self.disk_pages = disk_pages
-        if spill_dir is not None:
-            os.makedirs(spill_dir, exist_ok=True)
+        if store is None and spill_dir is not None:
+            store = LocalDirStore(spill_dir)
+        self.store = store
+        # per-writer namespace for store object names (node ids are only
+        # unique per process; two tiers sharing a store must not collide)
+        self.tag = f"{os.getpid()}x{next(_TIER_TAGS)}"
         # monotonic event counts (the engine mirrors the user-facing ones
         # into its MetricsRegistry; these back the invariant checks)
         self.disk_spills = 0
@@ -179,26 +302,54 @@ class HostKVTier:
                 raise RuntimeError(f"tier node {node_id} still pending d2h")
             self._host.move_to_end(node_id)
             return e
-        path = self._disk[node_id]
-        with np.load(path) as z:
-            data = {name: z[name] for name in z.files}
+        name = self._disk[node_id]      # KeyError: unknown node, degrade
+        try:
+            data = self.store.get(name)
+        except (OSError, ValueError) as e:
+            # object vanished/corrupted under us (shared store, another
+            # process GC'd it): same degrade contract as an unknown node
+            raise KeyError(f"tier node {node_id} store object {name!r} "
+                           f"unreadable: {e}") from e
         self.disk_restores += 1
         return data
 
     def pop(self, node_id: int) -> None:
-        """Remove an entry whose page moved back to the device tier."""
+        """Remove an entry whose page moved back to the device tier.
+        Shared store objects survive the pop — another replica (or a
+        restarted process) may still restore from them."""
         if self._host.pop(node_id, None) is None:
-            path = self._disk.pop(node_id)
-            os.remove(path)
+            name = self._disk.pop(node_id)
+            if node_id in self._shared:
+                self._shared.discard(node_id)
+            else:
+                self.store.delete(name)
 
     def drop(self, node_id: int) -> None:
-        """Discard an entry (node dropped from the index): host bytes and/or
-        disk file released."""
+        """Discard an entry (node dropped from the index): host bytes
+        released, and the store object too unless it is shared."""
         self._host.pop(node_id, None)
-        path = self._disk.pop(node_id, None)
-        if path is not None and os.path.exists(path):
-            os.remove(path)
+        name = self._disk.pop(node_id, None)
+        if name is not None and node_id not in self._shared:
+            self.store.delete(name)
+        self._shared.discard(node_id)
         self.tier_drops += 1
+
+    # ---- shared store (durable index / cross-engine handoff) --------------
+    def import_entry(self, node_id: int, name: str) -> None:
+        """Attach a store-resident page object (another writer's export, or
+        a previous process's spill) as a disk-level entry of THIS tier,
+        marked shared — restorable through the ordinary read path, never
+        deleted by local bookkeeping."""
+        if self.has(node_id):
+            raise RuntimeError(f"tier node {node_id} already present")
+        self._disk[node_id] = name
+        self._shared.add(node_id)
+
+    def mark_shared(self, node_ids: Iterable[int]) -> None:
+        """Entries just published in a serialized index: their store objects
+        may now be read by other replicas/processes, so local pop/drop must
+        stop deleting them."""
+        self._shared.update(nid for nid in node_ids if nid in self._disk)
 
     # ---- host -> disk cascade ---------------------------------------------
     def demotable(self) -> List[int]:
@@ -208,17 +359,17 @@ class HostKVTier:
                 if e is not self._PENDING]
 
     def to_disk(self, node_id: int) -> bool:
-        """Demote one host entry to the disk level; False when no spill_dir
-        is configured (the caller drops the node instead)."""
-        if self.spill_dir is None:
+        """Demote one host entry to the durable store level; False when no
+        store is configured (the caller drops the node instead)."""
+        if self.store is None:
             return False
         data = self._host[node_id]
         if data is self._PENDING:
             raise RuntimeError(f"cannot demote pending tier node {node_id}")
-        path = os.path.join(self.spill_dir, f"kvnode_{node_id}.npz")
-        np.savez(path, **data)
+        name = f"kvnode_{self.tag}_{node_id}"
+        self.store.put(name, data)
         del self._host[node_id]
-        self._disk[node_id] = path
+        self._disk[node_id] = name
         self.disk_spills += 1
         return True
 
@@ -414,6 +565,107 @@ class PagedKVCache:
         for node in nodes:
             if self._index.get(node.key) is node:
                 self._drop_node(node)
+
+    # ---- durable tier index (restart re-attach / cross-engine handoff) ----
+    def save_tier_index(self, tag: str = "main") -> int:
+        """Serialize the store-resident part of the prefix index — trie
+        topology, token content, page-object names — as ``kvindex_<tag>``
+        beside the page objects (versioned, atomic-rename-written).  Only
+        nodes whose WHOLE ancestor chain is store-resident are published: a
+        chain broken by a device/host-only ancestor is unreachable to a
+        reader anyway (`_match` walks from the root).  Publishing marks the
+        referenced page objects shared, so this tier stops deleting them on
+        pop/drop — another replica may now restore from them.  Returns the
+        node count published (0 with no store attached)."""
+        tier = self._tier
+        if tier is None or tier.store is None:
+            return 0
+        nodes = {n.node_id: n for n in self._index.values()
+                 if n.page < 0 and n.node_id in tier._disk}
+        ok: Dict[int, bool] = {_ROOT: True}
+
+        def _chain_ok(nid: int) -> bool:
+            got = ok.get(nid)
+            if got is None:
+                node = nodes.get(nid)
+                got = ok[nid] = node is not None and _chain_ok(node.key[0])
+            return got
+
+        rows = []
+        for nid in sorted(nodes):       # node ids are parent-first monotonic
+            node = nodes[nid]
+            if not _chain_ok(nid):
+                continue
+            rows.append({"id": nid, "parent": node.key[0],
+                         "tokens": np.frombuffer(node.key[1],
+                                                 np.int32).tolist(),
+                         "n_tokens": node.n_tokens,
+                         "name": tier._disk[nid]})
+        doc = {"version": TIER_INDEX_VERSION, "page_size": self.page_size,
+               "nodes": rows}
+        tier.store.put_blob(f"kvindex_{tag}",
+                            json.dumps(doc, sort_keys=True).encode("utf-8"))
+        tier.mark_shared(r["id"] for r in rows)
+        return len(rows)
+
+    def load_tier_index(self) -> int:
+        """Merge every readable ``kvindex_*`` blob in the attached store
+        into the live prefix index: each published node whose parent chain
+        resolves (locally known, or imported by an earlier row) and whose
+        page object still exists becomes an off-device node of THIS cache,
+        restorable through the ordinary one-scatter tier path.  Remote node
+        ids are remapped to fresh local ids as the rows are walked
+        parent-first.  Rows that are corrupt, version- or geometry-skewed,
+        already cached here, or missing their page object are skipped — a
+        damaged store can only cost a re-prefill, never a crash or a wrong
+        match (token content rides in the index, so the rebuilt
+        rolling-hash entries verify exactly like locally-registered ones).
+        Idempotent: re-merging is how a decode replica refreshes its view
+        of a shared store between handoffs.  Returns nodes imported."""
+        tier = self._tier
+        if tier is None or tier.store is None:
+            return 0
+        imported = 0
+        for _, payload in tier.store.blobs("kvindex_"):
+            try:
+                doc = json.loads(payload.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                continue                # corrupt blob: ignore entirely
+            if not isinstance(doc, dict) \
+                    or doc.get("version") != TIER_INDEX_VERSION \
+                    or doc.get("page_size") != self.page_size \
+                    or not isinstance(doc.get("nodes"), list):
+                continue                # version/geometry skew: ignore
+            idmap = {_ROOT: _ROOT}
+            for row in doc["nodes"]:
+                try:
+                    rid = int(row["id"])
+                    parent = int(row["parent"])
+                    toks = np.asarray(row["tokens"], np.int32)
+                    ntok = int(row["n_tokens"])
+                    name = str(row["name"])
+                except (KeyError, TypeError, ValueError):
+                    continue            # malformed row: skip
+                if parent not in idmap or toks.ndim != 1 \
+                        or toks.size != ntok \
+                        or not 0 < ntok <= self.page_size:
+                    continue
+                key = (idmap[parent], toks.tobytes())
+                known = self._index.get(key)
+                if known is not None:   # already cached here (any level)
+                    idmap[rid] = known.node_id
+                    continue
+                if not tier.store.exists(name):
+                    continue            # page object gone: chain ends here
+                nid = next(self._node_ids)
+                node = _PrefixNode(nid, key, HOST_PAGE, ntok)
+                self._index[key] = node
+                self._register_partial(node)
+                self._tier_nodes[nid] = node
+                tier.import_entry(nid, name)
+                idmap[rid] = nid
+                imported += 1
+        return imported
 
     def pool_pressure(self) -> float:
         """Fraction of the real pool in live use (0.0 idle .. 1.0 full) —
@@ -813,7 +1065,11 @@ class PagedKVCache:
     def release(self, slot: int) -> None:
         """Retire a slot: decrement its pages' refcounts; pages reaching 0 go
         back to the free list, unless they are registered cached prefixes —
-        those park in the LRU and stay matchable until evicted."""
+        those park in the LRU and stay matchable until evicted.  An abort
+        landing between `allocate_prefixed` and `take_restore` (or after a
+        failed restore) must not leak the un-consumed restore plan: the plan
+        is discarded here — the planned nodes simply stay in the tier."""
+        self._restore_plan.pop(slot, None)
         for p in reversed(self._used[slot]):
             self._ref[p] -= 1
             if self._ref[p] == 0:
@@ -901,8 +1157,22 @@ class PagedKVCache:
             assert self._index.get(node.key) is node, \
                 f"partial-index entry {k} points at an unregistered node"
             assert k in node.partial_keys
-        assert not self._restore_plan, \
-            f"unconsumed restore plans for slots {list(self._restore_plan)}"
+        # sixth (restore-plan) partition: a pending plan may only exist for a
+        # slot that is still allocated (release() discards the plan, so an
+        # aborted admission cannot strand one), and every planned placement
+        # targets a page the slot actually holds, sourced from a registered
+        # off-device node — the plan is a view over live state, never an
+        # owner of pages or tier entries
+        for slot, plan in self._restore_plan.items():
+            assert self._used[slot], \
+                f"restore plan pending for released slot {slot}"
+            row = set(self._used[slot])
+            for dst, node, n_tokens in plan:
+                assert dst in row, \
+                    f"slot {slot} restore plan targets foreign page {dst}"
+                assert self._index.get(node.key) is node and node.page < 0, \
+                    (f"slot {slot} restore plan sources node {node.node_id} "
+                     f"that is no longer an off-device index node")
 
     def prefix_stats(self) -> Dict[str, int]:
         return {

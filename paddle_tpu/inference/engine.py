@@ -525,6 +525,8 @@ class LLMEngine:
                  kv_tier: bool = True,
                  spill_dir: Optional[str] = None,
                  spill_disk_pages: Optional[int] = None,
+                 page_store=None,
+                 role: Optional[str] = None,
                  fault_plan: Optional[FaultPlan] = None,
                  weight_dtype: Optional[str] = None,
                  kv_dtype: Optional[str] = None,
@@ -686,12 +688,29 @@ class LLMEngine:
         self.kv_tier = bool(kv_tier) and prefix_cache and \
             self.swap_pool_pages > 0
         self.spill_dir = spill_dir if self.kv_tier else None
+        # disaggregated serving role (ROADMAP item 2): "prefill" engines run
+        # admission + chunked prefill and export finished prompts through the
+        # tier store; "decode" engines tier-restore them.  None = colocated
+        # (the classic engine).  The role changes ROUTING and HEALTH only —
+        # every engine keeps the full executable set, so a degraded handoff
+        # can always fall back to local re-prefill.
+        if role not in (None, "prefill", "decode"):
+            raise ValueError(f"role must be 'prefill', 'decode' or None, "
+                             f"got {role!r}")
+        self.role = role
+        self._store_restored_nodes = 0
         if self.kv_tier:
             from .cache import HostKVTier
             self.cache.attach_tier(
                 HostKVTier(spill_dir=self.spill_dir,
-                           disk_pages=spill_disk_pages),
+                           disk_pages=spill_disk_pages,
+                           store=page_store),
                 self._spill_prefix_nodes)
+            # durable-index re-attach: merge any kvindex_* blobs a previous
+            # process (or a prefill peer on the same store) published, so a
+            # restarted engine's first returning session tier-restores with
+            # one scatter instead of re-prefilling
+            self._store_restored_nodes = self.cache.load_tier_index()
         # optimistic-admission watermark: global free-page headroom kept back
         # at admission (vLLM's watermark_blocks), ~1% of the pool
         self._watermark = max(1, (self.cache.num_pages - 1) // 100)
@@ -798,6 +817,18 @@ class LLMEngine:
             "admissions whose prefix match ended inside a cached page "
             "(rolling-hash partial index: COW copy or tier scatter of the "
             "matched fraction)")
+        # disaggregated handoff surface: prompts a prefill-role engine
+        # exported through the shared tier store for a decode peer
+        self._handoff_exports = m.counter(
+            "kv_handoff_exports",
+            "finished prompts exported to the shared tier store for a "
+            "decode-role peer")
+        self._handoff_pages = m.counter(
+            "kv_handoff_pages", "KV pages published to the store by exports")
+        self._handoff_tokens = m.counter(
+            "kv_handoff_tokens",
+            "prompt tokens whose KV a decode peer can restore instead of "
+            "re-prefilling")
         # SLO accounting (deadline attainment + per-priority-class goodput):
         # attainment's denominator is EVERY retired deadline-bearing request
         # (timeouts and aborts count as misses there), while the latency
@@ -1971,6 +2002,83 @@ class LLMEngine:
                   tokens=int(tokens))
         return True
 
+    def export_prefix(self, tokens: np.ndarray,
+                      rid: Optional[int] = None) -> Dict[str, int]:
+        """Disaggregated handoff (send side): publish the cached KV chain of
+        `tokens` to the shared tier store so a DECODE-role peer can restore
+        it with one scatter.  Device-resident chain nodes that are parked in
+        the LRU (refcount 0 — the finished prompt just released them) spill
+        through the ordinary `_spill_prefix_nodes` gather, the pending d2h
+        is flushed, host entries are pushed to the store level, and the
+        durable index is re-published.  Zero new programs: the export rides
+        the same two swap executables the tier already warmed.  Returns
+        {"pages", "tokens", "index_nodes"} — all 0 when no store is
+        attached or nothing was exportable (the peer then degrades to local
+        re-prefill, parity-lossless)."""
+        from .cache import HOST_PAGE
+        out = {"pages": 0, "tokens": 0, "index_nodes": 0}
+        with self._serve_lock:
+            mgr = self.cache
+            tier = mgr._tier
+            if not self.kv_tier or tier is None or tier.store is None:
+                return out
+            full, partial = mgr._match(np.asarray(tokens, np.int32))
+            chain = list(full) + ([partial[0]] if partial else [])
+            if not chain:
+                return out
+            todo = [nd for nd in chain
+                    if nd.page >= 0 and nd.node_id in mgr._lru]
+            accepted = self._spill_prefix_nodes(todo) if todo else set()
+            for nd in todo:
+                if nd.node_id not in accepted:
+                    continue
+                # mirror _evict's accept bookkeeping: the page goes back to
+                # the free pool, the node becomes an off-device tier entry
+                mgr._lru.pop(nd.node_id)
+                mgr._free.append(nd.page)
+                del mgr._page_node[nd.page]
+                nd.page = HOST_PAGE
+                mgr._tier_nodes[nd.node_id] = nd
+                tier.add_pending(nd.node_id)
+            self._flush_pending_spills()
+            pages = tokens_out = 0
+            for nd in chain:
+                if nd.page >= 0 or tier.is_pending(nd.node_id):
+                    continue            # still on device / spill degraded
+                if nd.node_id in tier._host:
+                    tier.to_disk(nd.node_id)
+                if nd.node_id in tier._disk:
+                    pages += 1
+                    tokens_out += nd.n_tokens
+            out["pages"] = pages
+            out["tokens"] = tokens_out
+            out["index_nodes"] = mgr.save_tier_index(tag=tier.tag)
+            if pages:
+                self._handoff_exports.inc()
+                self._handoff_pages.inc(pages)
+                self._handoff_tokens.inc(tokens_out)
+                if rid is not None:
+                    # the prefill request has already retired (export runs
+                    # after result()), so its trace rides the RequestOutput
+                    # — _tev only sees live traces and would drop the event
+                    tr = self._trace_for(rid)
+                    if tr is not None:
+                        tr.event(self._now(), "handoff", pages=int(pages),
+                                 tokens=int(tokens_out))
+        return out
+
+    def refresh_store_index(self) -> int:
+        """Disaggregated handoff (receive side): re-merge the shared store's
+        published index so the NEXT admission can tier-restore prefixes a
+        prefill peer just exported.  Idempotent and cheap (already-known
+        nodes are skipped).  Returns nodes newly imported."""
+        if not self.kv_tier:
+            return 0
+        with self._serve_lock:
+            n = self.cache.load_tier_index()
+        self._store_restored_nodes += n
+        return n
+
     def _drop_preempted(self, rid: int) -> Optional[Dict[str, object]]:
         """Remove a resume record on abort/timeout, clearing any host swap
         obligation; returns the record (its banked generation feeds the
@@ -2937,6 +3045,7 @@ class LLMEngine:
             "prefill_chunk": self.prefill_chunk,
             "spec_len": self.spec_len,
             "mp": self.mp,
+            "role": self.role,
             "engine_steps": self._step_idx,
             "decode_iterations": self._decode_iters.value,
             "decode_tokens": self._decode_tokens.value,
@@ -3004,6 +3113,13 @@ class LLMEngine:
                                  else self.cache._tier.disk_restores,
                 "tier_drops": 0 if self.cache._tier is None
                               else self.cache._tier.tier_drops,
+                # disaggregated handoff surface (ROADMAP item 2)
+                "store": self.cache._tier is not None and
+                         self.cache._tier.store is not None,
+                "handoff_exports": self._handoff_exports.value,
+                "handoff_pages": self._handoff_pages.value,
+                "handoff_tokens": self._handoff_tokens.value,
+                "store_nodes_restored": self._store_restored_nodes,
             },
             # quantized serving surface: the knobs and the at-rest pool bytes
             # the capacity math is about (None = full-precision default)

@@ -117,8 +117,17 @@ def evaluate_engine_health(engine, slo: Dict[str, object] = None
          target=target)
 
     # ---- latency bounds (p99 vs the declared SLO) -------------------------
-    for name, hist, key in (("ttft_p99", engine._h_ttft, "ttft_p99_ms"),
-                            ("tpot_p99", engine._h_tpot, "tpot_p99_ms")):
+    # role-aware (disaggregated fleets): a prefill replica's only latency
+    # product is TTFT and a decode replica's is TPOT — holding a pool to the
+    # OTHER pool's bound would shed on a signal it cannot influence
+    role = getattr(engine, "role", None)
+    lat_signals = (("ttft_p99", engine._h_ttft, "ttft_p99_ms"),
+                   ("tpot_p99", engine._h_tpot, "tpot_p99_ms"))
+    if role == "prefill":
+        lat_signals = lat_signals[:1]
+    elif role == "decode":
+        lat_signals = lat_signals[1:]
+    for name, hist, key in lat_signals:
         bound = float(cfg[key])
         p99_ms = hist.percentile(99.0) * 1e3 if hist.count else 0.0
         note(name, "degraded" if p99_ms > bound else "ok",
@@ -175,4 +184,5 @@ def evaluate_engine_health(engine, slo: Dict[str, object] = None
     worst = max(signals.values(), key=lambda s: HEALTH_CODES[s["state"]])
     state = worst["state"]
     return {"state": state, "code": HEALTH_CODES[state], "reasons": reasons,
-            "signals": signals, "burn_rates": burns, "t": engine._now()}
+            "role": role, "signals": signals, "burn_rates": burns,
+            "t": engine._now()}

@@ -200,13 +200,14 @@ class ObservabilityServer:
             try:
                 h = e.health()
                 return {"state": h["state"], "code": h["code"],
-                        "reasons": h["reasons"], "signals": h["signals"]}
+                        "reasons": h["reasons"], "signals": h["signals"],
+                        "role": h.get("role")}
             except Exception as err:
                 # same shape as a real report (probes read code/signals)
                 return {"state": "error", "code": _ERROR_CODE,
                         "reasons": [f"health evaluation failed: "
                                     f"{type(err).__name__}: {err}"],
-                        "signals": {}}
+                        "signals": {}, "role": getattr(e, "role", None)}
 
         if self.fleet is not None:
             reports = {label: one(e) for label, e in self._engines()}

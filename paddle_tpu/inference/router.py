@@ -48,13 +48,32 @@ engine's jitted step functions are per-instance attributes precisely so a
 fleet can share them).  `tools/check_program_count.py` runs a 2-replica
 pass asserting per-replica program counts stay inside the single-engine
 budget and that the executable objects are literally shared.
+
+**Disaggregated prefill/decode** (`roles="P:D"`, ROADMAP item 2,
+DistServe/Splitwise-style): the fleet partitions its replicas into a
+PREFILL pool and a DECODE pool sharing one durable tier store
+(`spill_dir`).  A new prompt routes least-loaded onto a prefill replica,
+which runs admission + chunked prefill, generates one throwaway token, and
+`export_prefix`-publishes the prompt's KV pages + durable index to the
+store; the decode replica (chosen by affinity, sticky per session)
+`refresh_store_index`-merges the published index and its ordinary
+admission tier-restores the whole prompt with ONE scatter — long prefills
+never steal fused-step slots from decode batches.  A returning turn whose
+prefix the decode replica already holds skips the prefill hop entirely;
+a shed prefill pool or a failed export degrades to a direct decode-side
+submit (local re-prefill) — parity-lossless by construction, since the
+decode engine re-computes exactly what the store could not provide.
+Role-aware health: prefill replicas burn on TTFT only, decode replicas on
+TPOT only (`health.py`), so shedding matches each pool's actual SLO.
 """
 from __future__ import annotations
 
 import dataclasses
+import re
+import tempfile
 import time
 from collections import OrderedDict
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -70,6 +89,20 @@ _EXEC_ATTRS = ("_decode_fn", "_verify_fn", "_chunk_fn", "_prefill_fn",
 
 # health states a request must never be routed to
 _UNROUTABLE = ("overloaded", "error")
+
+
+def _parse_roles(roles: str) -> Tuple[int, int]:
+    """Parse a ``"P:D"`` / ``"2P:3D"`` role spec into (prefill, decode)
+    replica counts (an omitted count means 1)."""
+    m = re.fullmatch(r"(\d*)\s*P\s*:\s*(\d*)\s*D", str(roles).strip(), re.I)
+    if not m:
+        raise ValueError(f"roles must look like 'P:D' or '2P:3D', "
+                         f"got {roles!r}")
+    n_p = int(m.group(1)) if m.group(1) else 1
+    n_d = int(m.group(2)) if m.group(2) else 1
+    if n_p < 1 or n_d < 1:
+        raise ValueError(f"roles needs >= 1 replica per pool, got {roles!r}")
+    return n_p, n_d
 
 
 class FleetOverloaded(RuntimeError):
@@ -174,24 +207,41 @@ class EngineFleet:
     def __init__(self, params=None, config=None, *, replicas: int = 2,
                  engines: Optional[List[LLMEngine]] = None,
                  router: str = "affinity",
+                 roles: Optional[str] = None,
                  shed_retry_after_s: float = 1.0,
                  victim_pressure: float = 0.85,
                  victim_churn: float = 0.5,
+                 handoff_timeout_s: float = 120.0,
                  engine_kwargs: Optional[Dict[str, object]] = None):
         if router not in ROUTER_POLICIES:
             raise ValueError(f"unknown router policy {router!r}; "
                              f"expected one of {ROUTER_POLICIES}")
         self.router = router
+        self.roles = roles
         self.shed_retry_after_s = float(shed_retry_after_s)
         self.victim_pressure = float(victim_pressure)
         self.victim_churn = float(victim_churn)
+        self.handoff_timeout_s = float(handoff_timeout_s)
+        role_list: Optional[List[Optional[str]]] = None
+        if roles is not None:
+            n_p, n_d = _parse_roles(roles)
+            role_list = ["prefill"] * n_p + ["decode"] * n_d
         if engines is None:
             if params is None or config is None:
                 raise ValueError("EngineFleet needs (params, config) or "
                                  "pre-built engines=[...]")
+            kw = dict(engine_kwargs or {})
+            if role_list is not None:
+                replicas = len(role_list)
+                # disaggregation moves KV through the durable tier store:
+                # force the tier on and give every pool member the SAME
+                # store root so any decode replica can restore any prompt
+                kw.setdefault("kv_tier", True)
+                kw.setdefault("spill_dir",
+                              tempfile.mkdtemp(prefix="kvstore_"))
+                kw["role"] = role_list[0]
             if replicas < 1:
                 raise ValueError(f"replicas must be >= 1, got {replicas}")
-            kw = dict(engine_kwargs or {})
             leader = LLMEngine(params, config, **kw)
             engines = [leader]
             if replicas > 1:
@@ -200,12 +250,24 @@ class EngineFleet:
                 # its compiled executables — dp replication adds ZERO
                 # programs per mesh config
                 kw.setdefault("mesh", leader.mesh)
-                for _ in range(1, replicas):
+                for i in range(1, replicas):
+                    if role_list is not None:
+                        kw["role"] = role_list[i]
                     e = LLMEngine(params, config, **kw)
                     _adopt_executables(e, leader)
                     engines.append(e)
         self.engines: "OrderedDict[str, LLMEngine]" = OrderedDict(
             (f"engine{i}", e) for i, e in enumerate(engines))
+        # role pools (pre-built engines partition by their declared role)
+        self.prefill_pool = [l for l, e in self.engines.items()
+                             if e.role == "prefill"]
+        self.decode_pool = [l for l, e in self.engines.items()
+                            if e.role == "decode"]
+        if roles is not None and not (self.prefill_pool and self.decode_pool):
+            raise ValueError(
+                f"roles={roles!r} needs >= 1 prefill and >= 1 decode "
+                f"replica; got pools {self.prefill_pool} / "
+                f"{self.decode_pool}")
         self.fleet_metrics = FleetMetrics()
         for label, eng in self.engines.items():
             self.fleet_metrics.add(label, eng)
@@ -213,6 +275,13 @@ class EngineFleet:
         self._rr = 0
         self.shed_count = 0
         self._submitted: Dict[str, int] = {l: 0 for l in self.engines}
+        # handoff telemetry (disaggregated mode): per-handoff wall latency
+        # (prefill submit -> store published + decode index refreshed),
+        # plus skip (warm continuation) / degrade (fell back to decode-side
+        # re-prefill) counts
+        self.handoff_ms: List[float] = []
+        self.handoff_skips = 0
+        self.handoff_degrades = 0
 
     # ---- lifecycle --------------------------------------------------------
     def start(self, idle_wait_s: float = 0.002) -> "EngineFleet":
@@ -276,18 +345,21 @@ class EngineFleet:
             v.state = "error"
         return v
 
-    def views(self, prompt=None,
-              session: Optional[str] = None) -> List[ReplicaView]:
+    def views(self, prompt=None, session: Optional[str] = None,
+              labels: Optional[List[str]] = None) -> List[ReplicaView]:
         sticky = self._sessions.get(session) if session is not None else None
         return [self._view(label, eng, prompt, sticky)
-                for label, eng in self.engines.items()]
+                for label, eng in self.engines.items()
+                if labels is None or label in labels]
 
     def select(self, prompt=None, *, session: Optional[str] = None,
-               priority: int = 0, policy: Optional[str] = None) -> str:
-        """Route: the chosen replica's label, or raise `FleetOverloaded`."""
+               priority: int = 0, policy: Optional[str] = None,
+               labels: Optional[List[str]] = None) -> str:
+        """Route: the chosen replica's label, or raise `FleetOverloaded`.
+        `labels` restricts the candidate set (disagg role pools)."""
         policy = policy or self.router
         views = self.views(
-            prompt if policy == "affinity" else None, session)
+            prompt if policy == "affinity" else None, session, labels)
         if policy == "round_robin":
             usable = [v for v in views if v.state not in _UNROUTABLE]
             if usable:
@@ -313,7 +385,14 @@ class EngineFleet:
                temperature: Optional[float] = None, priority: int = 0,
                deadline_s: Optional[float] = None) -> FleetHandle:
         """Route + enqueue.  Raises `FleetOverloaded` when shedding; the
-        per-engine validation/rejection semantics are `add_request`'s."""
+        per-engine validation/rejection semantics are `add_request`'s.
+        With `roles` set the request takes the disaggregated path instead
+        (prefill-pool hop + store handoff + decode-pool submit)."""
+        if self.roles is not None:
+            return self._submit_disagg(
+                prompt, session=session, max_new_tokens=max_new_tokens,
+                temperature=temperature, priority=priority,
+                deadline_s=deadline_s)
         label = self.select(prompt, session=session, priority=priority,
                             policy=policy)
         rid = self.engines[label].submit(
@@ -323,6 +402,58 @@ class EngineFleet:
             self._sessions[session] = label
         self._submitted[label] += 1
         return FleetHandle(label=label, rid=rid, session=session)
+
+    def _submit_disagg(self, prompt, *, session: Optional[str],
+                       max_new_tokens: int, temperature: Optional[float],
+                       priority: int,
+                       deadline_s: Optional[float]) -> FleetHandle:
+        """Disaggregated routing: decode replica by affinity (sticky per
+        session), prefill hop only when the decode replica is cold on this
+        prompt.  Every degrade point (prefill pool shed, prefill timeout,
+        empty export) falls through to the plain decode-side submit — the
+        decode engine re-prefills locally, so outputs never depend on the
+        handoff succeeding."""
+        dlabel = self.select(prompt, session=session, priority=priority,
+                             policy="affinity", labels=self.decode_pool)
+        deng = self.engines[dlabel]
+        prompt = np.asarray(prompt, np.int32)
+        probe = deng.probe_affinity(prompt)
+        if probe["cached_tokens"] * 2 >= prompt.size:
+            # warm continuation: the decode replica already holds most of
+            # the conversation — a prefill hop would only add latency
+            self.handoff_skips += 1
+        else:
+            try:
+                plabel = self.select(None, priority=priority,
+                                     policy="least_loaded",
+                                     labels=self.prefill_pool)
+            except FleetOverloaded:
+                plabel = None           # prefill pool shed: degrade
+            if plabel is None:
+                self.handoff_degrades += 1
+            else:
+                peng = self.engines[plabel]
+                t0 = time.monotonic()
+                prid = peng.submit(prompt, max_new_tokens=1,
+                                   temperature=temperature)
+                self._submitted[plabel] += 1
+                out = peng.result(prid, timeout=self.handoff_timeout_s)
+                exp = {"pages": 0}
+                if out is not None and out.finish_reason in ("stop",
+                                                             "length"):
+                    exp = peng.export_prefix(prompt, rid=prid)
+                if exp["pages"] > 0:
+                    deng.refresh_store_index()
+                    self.handoff_ms.append((time.monotonic() - t0) * 1e3)
+                else:
+                    self.handoff_degrades += 1
+        rid = deng.submit(prompt, max_new_tokens=max_new_tokens,
+                          temperature=temperature, priority=priority,
+                          deadline_s=deadline_s)
+        if session is not None:
+            self._sessions[session] = dlabel
+        self._submitted[dlabel] += 1
+        return FleetHandle(label=dlabel, rid=rid, session=session)
 
     def _engine_of(self, handle: FleetHandle) -> LLMEngine:
         try:
@@ -355,7 +486,8 @@ class EngineFleet:
             if float(h.get("code", 99)) > float(worst.get("code", 0)):
                 worst = dict(h)
         worst["per_engine"] = {l: {"state": h.get("state"),
-                                   "code": h.get("code")}
+                                   "code": h.get("code"),
+                                   "role": self.engines[l].role}
                                for l, h in per.items()}
         return worst
 
@@ -367,6 +499,7 @@ class EngineFleet:
             with eng._serve_lock:
                 st = eng.stats()
             per[label] = {
+                "role": st["role"],
                 "queue_depth": (st["queued"] + st["prefilling"] +
                                 st["running"]),
                 "decode_tokens": st["decode_tokens"],
@@ -375,11 +508,28 @@ class EngineFleet:
                 "health": st["health"],
                 "submitted": self._submitted[label],
             }
-        return {"router": self.router,
-                "replicas": len(self.engines),
-                "sessions": len(self._sessions),
-                "shed": self.shed_count,
-                "per_engine": per}
+        out = {"router": self.router,
+               "replicas": len(self.engines),
+               "sessions": len(self._sessions),
+               "shed": self.shed_count,
+               "per_engine": per}
+        if self.roles is not None:
+            ms = sorted(self.handoff_ms)
+
+            def _pct(q: float) -> float:
+                return ms[min(len(ms) - 1, int(q * len(ms)))] if ms else 0.0
+
+            out["disagg"] = {
+                "roles": self.roles,
+                "prefill_pool": list(self.prefill_pool),
+                "decode_pool": list(self.decode_pool),
+                "handoffs": len(ms),
+                "handoff_skips": self.handoff_skips,
+                "handoff_degrades": self.handoff_degrades,
+                "handoff_p50_ms": round(_pct(0.50), 3),
+                "handoff_p99_ms": round(_pct(0.99), 3),
+            }
+        return out
 
     def check_invariants(self) -> None:
         for eng in self.engines.values():

@@ -1,0 +1,301 @@
+"""Disaggregated prefill/decode serving (ISSUE 17): the durable tier
+index, engine-restart session restore, cross-engine page handoff through
+the shared store, role-aware fleet routing, and the degrade paths.
+
+The load-bearing bars:
+- a FRESH engine on the same `spill_dir` re-attaches the serialized index
+  at construction and restores a returning session with ONE
+  `swap_in_pages` scatter (dispatch count asserted), byte-identical to a
+  cold re-prefill oracle;
+- corrupted or version-skewed index blobs and vanished page objects
+  degrade to re-prefill — never a crash, never different tokens;
+- an abort landing between `allocate_prefixed` and `take_restore` releases
+  the un-consumed restore plan (`release` discards it; `check_invariants`
+  partitions the survivors);
+- a 1P:1D `EngineFleet` emits byte-exact greedy tokens vs the colocated
+  single-engine oracle on the same multi-turn stream, with the
+  `kv_handoff_*` counters moving and health role-labeled;
+- `FaultPlan.fail_h2d` on the decode pool degrades every store restore to
+  local re-prefill, parity-lossless.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu.inference.cache import (HostKVTier, PagedKVCache,
+                                        TIER_INDEX_VERSION)
+from paddle_tpu.inference.engine import LLMEngine
+from paddle_tpu.inference.faults import FaultPlan
+from paddle_tpu.models import gpt as G
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return G.gpt_tiny(64)
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return G.init_params(cfg, jax.random.key(0))
+
+
+def _engine(params, cfg, **kw):
+    base = dict(num_slots=2, page_size=8, num_pages=9, max_model_len=64,
+                prefill_chunk=16, seed=3, swap_pool_pages=64)
+    base.update(kw)
+    return LLMEngine(params, cfg, **base)
+
+
+def _serve_and_export(params, cfg, spill_dir, rng_seed=7):
+    """Engine A serves turn 1 on `spill_dir`, exports the conversation to
+    the store, and is destroyed.  Returns (returning-turn prompt, the
+    oracle's returning-turn tokens from a cold tier-less engine)."""
+    rng = np.random.RandomState(rng_seed)
+    prompt = rng.randint(0, cfg.vocab_size, (20,)).astype(np.int32)
+    eng_a = _engine(params, cfg, spill_dir=spill_dir)
+    out1 = eng_a.result(eng_a.add_request(prompt, max_new_tokens=5))
+    conv = np.concatenate([prompt, np.asarray(out1.token_ids, np.int32)])
+    exp = eng_a.export_prefix(conv)
+    assert exp["pages"] > 0 and exp["index_nodes"] > 0
+    eng_a.cache.check_invariants()
+    del eng_a
+    conv2 = np.concatenate([conv, rng.randint(0, cfg.vocab_size, (4,))
+                            .astype(np.int32)])
+    oracle = _engine(params, cfg)          # cold: pure re-prefill baseline
+    ref = oracle.result(oracle.add_request(conv2, max_new_tokens=5))
+    return conv2, list(ref.token_ids)
+
+
+# ---------------------------------------------------------------------------
+# engine restart: the durable index re-attaches, one scatter, byte parity
+# ---------------------------------------------------------------------------
+
+def test_restart_restores_with_one_scatter(params, cfg, tmp_path):
+    """Kill an engine mid-conversation, construct a fresh one on the same
+    spill_dir: the returning turn re-attaches the serialized index and
+    restores with exactly ONE swap_in dispatch, tokens byte-identical to a
+    cold re-prefill."""
+    conv2, ref = _serve_and_export(params, cfg, str(tmp_path))
+    eng_b = _engine(params, cfg, spill_dir=str(tmp_path))
+    assert eng_b._store_restored_nodes > 0     # index re-attached at init
+    calls = []
+    orig = eng_b._swap_in_fn
+
+    def counting(*a, **kw):
+        calls.append(1)
+        return orig(*a, **kw)
+
+    eng_b._swap_in_fn = counting
+    out = eng_b.result(eng_b.add_request(conv2, max_new_tokens=5))
+    eng_b._swap_in_fn = orig
+    assert list(out.token_ids) == ref
+    assert len(calls) == 1, f"restore took {len(calls)} scatters, not 1"
+    st = eng_b.stats()
+    assert st["kv_tier"]["restores"] == 1
+    assert st["kv_tier"]["restored_tokens"] >= 16      # >= 2 full pages
+    assert st["kv_tier"]["store_nodes_restored"] > 0
+    # zero new compiled programs: restore rode the warmed swap bucket
+    assert st["swap_executables"] <= 2
+    eng_b.cache.check_invariants()
+
+
+def test_corrupted_index_degrades_to_reprefill(params, cfg, tmp_path):
+    """A truncated/garbage index blob imports nothing: the returning turn
+    re-prefills and emits the same tokens — no crash, no drift."""
+    conv2, ref = _serve_and_export(params, cfg, str(tmp_path))
+    blobs = [f for f in os.listdir(str(tmp_path)) if f.startswith("kvindex_")]
+    assert blobs
+    for b in blobs:
+        with open(os.path.join(str(tmp_path), b), "wb") as f:
+            f.write(b"{corrupt json \xff\xfe")
+    eng_b = _engine(params, cfg, spill_dir=str(tmp_path))
+    assert eng_b._store_restored_nodes == 0
+    out = eng_b.result(eng_b.add_request(conv2, max_new_tokens=5))
+    assert list(out.token_ids) == ref
+    assert eng_b.stats()["kv_tier"]["restores"] == 0
+    eng_b.cache.check_invariants()
+
+
+def test_version_skewed_index_is_ignored(params, cfg, tmp_path):
+    """An index written by a future (or ancient) format version is skipped
+    wholesale — restart degrades to re-prefill instead of misreading it."""
+    conv2, ref = _serve_and_export(params, cfg, str(tmp_path))
+    for b in os.listdir(str(tmp_path)):
+        if not b.startswith("kvindex_"):
+            continue
+        path = os.path.join(str(tmp_path), b)
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["version"] == TIER_INDEX_VERSION
+        doc["version"] = 99
+        with open(path, "w") as f:
+            json.dump(doc, f)
+    eng_b = _engine(params, cfg, spill_dir=str(tmp_path))
+    assert eng_b._store_restored_nodes == 0
+    out = eng_b.result(eng_b.add_request(conv2, max_new_tokens=5))
+    assert list(out.token_ids) == ref
+    eng_b.cache.check_invariants()
+
+
+def test_missing_page_object_breaks_chain_not_engine(params, cfg, tmp_path):
+    """Deleting a kvnode page object mid-chain imports only the ancestors
+    that still resolve; the returning turn restores what survived and
+    re-prefills the rest — same tokens."""
+    conv2, ref = _serve_and_export(params, cfg, str(tmp_path))
+    pages = sorted(f for f in os.listdir(str(tmp_path))
+                   if f.startswith("kvnode_"))
+    assert len(pages) >= 2
+    os.remove(os.path.join(str(tmp_path), pages[1]))   # mid-chain object
+    eng_b = _engine(params, cfg, spill_dir=str(tmp_path))
+    assert 0 < eng_b._store_restored_nodes < len(pages)
+    out = eng_b.result(eng_b.add_request(conv2, max_new_tokens=5))
+    assert list(out.token_ids) == ref
+    eng_b.cache.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# bugfix: abort while a tier-restore plan is pending
+# ---------------------------------------------------------------------------
+
+def test_release_discards_pending_restore_plan(tmp_path):
+    """An abort landing between `allocate_prefixed` (which plans a tier
+    restore) and `take_restore` must not strand the plan: `release`
+    discards it, the planned nodes stay in the tier, and the
+    `check_invariants` restore-plan partition stays green."""
+    mgr = PagedKVCache(num_pages=9, page_size=4, num_slots=2,
+                       max_pages_per_slot=8)
+    tier = HostKVTier(spill_dir=str(tmp_path), disk_pages=64)
+    mgr.attach_tier(tier, lambda nodes: {nd.node_id for nd in nodes})
+    toks = np.arange(12, dtype=np.int32)
+    mgr.allocate(0, 12)
+    mgr.lengths[0] = 12
+    mgr.register_prefix(0, toks, 12)
+    mgr.release(0)
+    # park the whole chain in the tier (the engine's accept bookkeeping)
+    full, partial = mgr._match(toks)
+    for nd in list(full) + [partial[0]]:
+        mgr._lru.pop(nd.node_id)
+        mgr._free.append(nd.page)
+        del mgr._page_node[nd.page]
+        nd.page = -1
+        mgr._tier_nodes[nd.node_id] = nd
+        tier.add_pending(nd.node_id)
+        tier.fill(nd.node_id, {"k": np.zeros((4,), np.float32)})
+    mgr.check_invariants()
+    _, matched, _ = mgr.allocate_prefixed(0, 12, toks)
+    assert matched > 0
+    assert mgr._restore_plan.get(0), "admission should have planned a restore"
+    mgr.check_invariants()          # plan pending for an allocated slot: ok
+    mgr.release(0)                  # abort before take_restore
+    assert not mgr._restore_plan, "release leaked the un-consumed plan"
+    mgr.check_invariants()
+    # the planned nodes are still tier-resident and still matchable
+    _, matched2, _ = mgr.allocate_prefixed(1, 12, toks)
+    assert matched2 == matched
+    plan = mgr.take_restore(1)
+    assert plan
+    mgr.release(1)
+    mgr.check_invariants()
+
+
+def test_engine_abort_between_plan_and_restore(params, cfg, tmp_path):
+    """Engine-level: aborting a queued request whose admission would have
+    tier-restored leaves no stranded plan (drain invariants hold) and the
+    session is still restorable afterwards."""
+    conv2, ref = _serve_and_export(params, cfg, str(tmp_path))
+    eng_b = _engine(params, cfg, spill_dir=str(tmp_path))
+    rid = eng_b.add_request(conv2, max_new_tokens=5)
+    eng_b.abort(rid)
+    eng_b.cache.check_invariants()
+    out = eng_b.result(eng_b.add_request(conv2, max_new_tokens=5))
+    assert list(out.token_ids) == ref
+    eng_b.cache.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# 1P:1D fleet: handoff parity + counters + role-labeled health
+# ---------------------------------------------------------------------------
+
+def test_disagg_fleet_parity_and_handoff_counters(params, cfg):
+    """A 1P:1D fleet serves a 2-session x 2-turn stream byte-identically to
+    one colocated engine, with prefill exports and decode tier-restores
+    both visible in the counters and health labeled per role."""
+    from paddle_tpu.inference.router import EngineFleet
+
+    ekw = dict(num_slots=2, page_size=8, max_model_len=64,
+               prefill_chunk=16, seed=3)
+    rng = np.random.RandomState(9)
+    prompts = [rng.randint(0, cfg.vocab_size, (18,)).astype(np.int32)
+               for _ in range(2)]
+
+    oracle = LLMEngine(params, cfg, **ekw)
+    ref, convs = {}, [list(p) for p in prompts]
+    for s in range(2):
+        for t in range(2):
+            o = oracle.result(oracle.add_request(
+                np.asarray(convs[s], np.int32), max_new_tokens=5))
+            ref[(s, t)] = list(o.token_ids)
+            convs[s] = convs[s] + ref[(s, t)]
+
+    fleet = EngineFleet(params, cfg, roles="P:D", engine_kwargs=dict(ekw))
+    assert fleet.prefill_pool and fleet.decode_pool
+    fleet.warm()
+    convs = [list(p) for p in prompts]
+    with fleet:
+        for s in range(2):
+            for t in range(2):
+                h = fleet.submit(np.asarray(convs[s], np.int32),
+                                 session=f"s{s}", max_new_tokens=5)
+                out = fleet.result(h, timeout=120.0)
+                assert out is not None
+                assert list(out.token_ids) == ref[(s, t)], (s, t)
+                convs[s] = convs[s] + list(out.token_ids)
+        fleet.check_invariants()
+        pe = fleet.engines[fleet.prefill_pool[0]]
+        de = fleet.engines[fleet.decode_pool[0]]
+        assert pe.stats()["kv_tier"]["handoff_exports"] >= 1
+        assert pe.stats()["kv_tier"]["handoff_pages"] >= 1
+        assert de.stats()["kv_tier"]["restores"] >= 1
+        fst = fleet.stats()
+        assert fst["disagg"]["handoffs"] >= 1
+        assert fst["disagg"]["handoff_p99_ms"] > 0
+        h = fleet.health()
+        roles = {h["per_engine"][l]["role"] for l in fleet.prefill_pool}
+        assert roles == {"prefill"}
+        roles = {h["per_engine"][l]["role"] for l in fleet.decode_pool}
+        assert roles == {"decode"}
+
+
+def test_disagg_fail_h2d_degrades_to_local_reprefill(params, cfg, tmp_path):
+    """Pre-built 1P:1D pools where every decode-side restore h2d fails:
+    handoffs export fine, the decode engine drops each planned restore and
+    re-prefills locally — tokens still byte-identical to the oracle."""
+    from paddle_tpu.inference.router import EngineFleet
+
+    ekw = dict(num_slots=2, page_size=8, max_model_len=64,
+               prefill_chunk=16, seed=3, spill_dir=str(tmp_path))
+    rng = np.random.RandomState(13)
+    prompt = rng.randint(0, cfg.vocab_size, (20,)).astype(np.int32)
+
+    oracle = LLMEngine(params, cfg, **dict(ekw, spill_dir=None))
+    ref = list(oracle.result(oracle.add_request(
+        prompt, max_new_tokens=5)).token_ids)
+
+    pe = LLMEngine(params, cfg, role="prefill", **ekw)
+    de = LLMEngine(params, cfg, role="decode",
+                   fault_plan=FaultPlan(fail_h2d=1000), **ekw)
+    fleet = EngineFleet(engines=[pe, de], roles="P:D")
+    with fleet:
+        h = fleet.submit(prompt, session="s0", max_new_tokens=5)
+        out = fleet.result(h, timeout=120.0)
+        assert out is not None
+        assert list(out.token_ids) == ref
+        fleet.check_invariants()
+    assert pe.stats()["kv_tier"]["handoff_exports"] >= 1
+    assert de.stats()["kv_tier"]["restores"] == 0      # every restore failed

@@ -433,6 +433,10 @@ NEW_STATS_KEYS = frozenset({
     # added by the KV tiering PR: per-tier occupancy + spill/restore traffic
     # + the rolling-hash partial-index hit counter
     "kv_tier",
+}) | frozenset({
+    # added by the disaggregated-serving PR: the engine's fleet role
+    # (None / "prefill" / "decode") so health and routing can label it
+    "role",
 })
 
 

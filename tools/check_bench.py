@@ -15,7 +15,8 @@ nothing noticed a regression until a human did.  This tool closes that gap:
   through `append_bench_row()` here, so the row shape and its validator
   live in one file.
 - **Floors** (`--ci`): runs a fresh CPU-smoke bench (subprocess, exactly
-  what a human would run — `--replicas 2` so the dp-fleet passes run too)
+  what a human would run — `--replicas 2 --disagg P:D` so the dp-fleet and
+  disaggregated prefill/decode passes run too)
   and enforces `SERVE_PERF_FLOORS` — declared ONCE in
   `paddle_tpu/analysis/registry.py` next to the resource budgets: every
   parity flag true (fleet_parity included), dispatches/step within the
@@ -42,7 +43,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_HISTORY = os.path.join(_REPO, "BENCH_SERVE.jsonl")
 
-ROW_SCHEMA_VERSION = 3
+ROW_SCHEMA_VERSION = 4
 
 # the axes that make rows comparable across PRs: two rows agree on "mode"
 # or their perf numbers are not the same experiment.  v1 rows (pre KV
@@ -55,7 +56,10 @@ MODE_AXES_V2 = MODE_AXES_V1 + ("kv_tier", "multi_turn",
                                "session_return_frac")
 # v3 (serving front door PR): the dp fleet axes — replica count + routing
 # policy (router is null on single-engine rows)
-MODE_AXES = MODE_AXES_V2 + ("replicas", "router")
+MODE_AXES_V3 = MODE_AXES_V2 + ("replicas", "router")
+# v4 (disaggregated serving PR): the prefill/decode role split ("P:D" on
+# disagg rows, null otherwise) and the engine-restart restore sub-pass
+MODE_AXES = MODE_AXES_V3 + ("disagg", "restart")
 # the perf surface a trajectory reader plots; absent-in-this-mode metrics
 # (e.g. goodput_ratio without --oversubscribe) ride as null
 PERF_KEYS_V1 = ("decode_tokens_per_sec_per_chip", "generated_tokens_per_sec",
@@ -77,18 +81,26 @@ PERF_KEYS_V2 = PERF_KEYS_V1 + (
     "returning_prefilled_drop", "returning_ttft_p50_ms")
 # v3: the fleet surface — requested-router throughput/balance plus the
 # affinity-vs-round-robin A/B on the identical session stream
-PERF_KEYS = PERF_KEYS_V2 + (
+PERF_KEYS_V3 = PERF_KEYS_V2 + (
     "fleet_generated_tokens_per_sec", "replica_balance", "fleet_shed",
     "affinity_prefix_hit_rate", "round_robin_prefix_hit_rate",
     "affinity_prefix_hit_ratio", "affinity_returning_ttft_p50_ms",
     "round_robin_returning_ttft_p50_ms", "fleet_shared_executables")
+# v4: the disaggregation surface — store-handoff latency, the prefill-
+# interference delta on decode TPOT, and the restart restore sub-pass
+PERF_KEYS = PERF_KEYS_V3 + (
+    "handoff_p50_ms", "handoff_p99_ms", "handoff_count",
+    "interference_tpot_delta_ms", "restart_restored_tokens",
+    "restart_ttft_ms")
 PARITY_KEYS = ("fuse_parity", "spec_parity", "oversubscribe_parity",
-               "tracing_parity", "kv_tier_parity", "fleet_parity")
+               "tracing_parity", "kv_tier_parity", "fleet_parity",
+               "disagg_parity")
 REQUIRED_ROW_KEYS = frozenset({"schema_version", "t", "mode", "perf",
                                "parity"})
 _AXES_BY_VERSION = {1: (MODE_AXES_V1, PERF_KEYS_V1),
                     2: (MODE_AXES_V2, PERF_KEYS_V2),
-                    3: (MODE_AXES, PERF_KEYS)}
+                    3: (MODE_AXES_V3, PERF_KEYS_V3),
+                    4: (MODE_AXES, PERF_KEYS)}
 
 
 def bench_row(stats, t=None):
@@ -205,6 +217,17 @@ def check_floors(row, floors=None):
             errors.append("fleet_shared_executables is not True — dp "
                           "replicas stopped adopting the leader's compiled "
                           "programs (replication must add zero executables)")
+    # disaggregation floor: every handoff must complete within the declared
+    # ceiling (a store handoff slower than a re-prefill defeats the split)
+    if mode.get("disagg"):
+        hp99 = perf.get("handoff_p99_ms")
+        cap = floors.get("handoff_p99_ms_max")
+        if cap is not None and (not isinstance(hp99, (int, float)) or
+                                hp99 > cap):
+            errors.append(f"handoff_p99_ms {hp99!r} missing or above the "
+                          f"declared {cap} ceiling — prefill->decode store "
+                          f"handoff is slower than the re-prefill it "
+                          f"replaces")
     return errors
 
 
@@ -252,7 +275,7 @@ def run_ci_bench():
     env.setdefault("JAX_PLATFORMS", "cpu")
     proc = subprocess.run(
         [sys.executable, os.path.join(_REPO, "bench_serve.py"),
-         "--no-history", "--replicas", "2"],
+         "--no-history", "--replicas", "2", "--disagg", "P:D"],
         capture_output=True, text=True, cwd=_REPO, env=env, timeout=1800)
     if proc.returncode != 0:
         raise RuntimeError(f"bench_serve.py failed (rc={proc.returncode}):\n"

@@ -44,6 +44,11 @@ or make a "counter" go backwards:
   sums — `/stats` is the per-label map, `/healthz` is the worst-of fleet
   rollup (503 the moment any member reads overloaded), and the 404 route
   list advertises the inference endpoints;
+- **disagg smoke** — a 1P:1D role fleet over the durable tier store: the
+  `kv_handoff_*` counters move on the prefill replica and `kv_tier_restores`
+  on the decode replica, the prefill request's timeline carries the
+  `handoff` event, and `/healthz` served through the front door labels every
+  per-engine entry with its role;
 - **monotonicity** — across a CPU-smoke engine loop that exercises admission,
   chunked prefill, speculative verify, prefix hits, LRU eviction AND abort,
   no counter ever decreases between steps;
@@ -99,6 +104,10 @@ REQUIRED_KV_TIER_KEYS = frozenset({
     "enabled", "spill_dir", "pages_host", "pages_disk", "spills",
     "restores", "restored_tokens", "partial_page_hits", "disk_spills",
     "disk_restores", "tier_drops",
+    # disaggregated serving PR (ISSUE 17): the durable store + cross-engine
+    # handoff surface
+    "store", "handoff_exports", "handoff_pages", "handoff_tokens",
+    "store_nodes_restored",
 })
 REQUIRED_SLO_KEYS = frozenset({
     "deadline_requests", "deadline_met", "deadline_attainment",
@@ -131,6 +140,8 @@ REQUIRED_COUNTERS = frozenset({
     # KV tiering PR: spill/restore traffic + rolling-hash partial hits
     "kv_tier_spills", "kv_tier_restores", "kv_tier_restored_tokens",
     "partial_page_hits",
+    # disaggregated serving PR: prefill->decode handoffs through the store
+    "kv_handoff_exports", "kv_handoff_pages", "kv_handoff_tokens",
 })
 REQUIRED_DEBUG_BUNDLE_KEYS = frozenset({
     "version", "t", "engine", "pool", "requests", "step_trace", "stats",
@@ -665,6 +676,83 @@ def check_front_door(errors):
         fleet.stop()
 
 
+def check_disagg(errors):
+    """Disaggregated-serving observability (ISSUE 17): a 1P:1D role fleet
+    serving a returning conversation must move the `kv_handoff_*` counters
+    on the prefill replica and `kv_tier_restores` on the decode replica,
+    stamp a `handoff` event on the prefill request's timeline, and expose
+    role-labeled per-engine health through the serving front door."""
+    import urllib.error
+    import urllib.request
+
+    import jax
+    import numpy as np
+
+    from paddle_tpu.inference.frontend import ServingFrontend
+    from paddle_tpu.inference.router import EngineFleet
+    from paddle_tpu.models import gpt as G
+
+    cfg = G.gpt_tiny(64)
+    params = G.init_params(cfg, jax.random.key(2))
+    fleet = EngineFleet(params, cfg, roles="P:D",
+                        engine_kwargs=dict(num_slots=2, page_size=8,
+                                           max_model_len=64,
+                                           prefill_chunk=16, seed=2))
+    fleet.warm()
+    fleet.start()
+    door = ServingFrontend(fleet).start()
+    try:
+        rng = np.random.RandomState(5)
+        conv = list(rng.randint(0, cfg.vocab_size, (20,)).astype(np.int32))
+        for _turn in range(2):
+            h = fleet.submit(np.asarray(conv, np.int32), session="s0",
+                             max_new_tokens=4)
+            out = fleet.result(h, timeout=120.0)
+            if out is None:
+                errors.append("disagg smoke turn timed out")
+                return
+            conv = conv + list(out.token_ids)
+        pe = fleet.engines[fleet.prefill_pool[0]]
+        de = fleet.engines[fleet.decode_pool[0]]
+        pc = pe.metrics.snapshot()["counters"]
+        for k in ("kv_handoff_exports", "kv_handoff_pages",
+                  "kv_handoff_tokens"):
+            if pc.get(k, 0) < 1:
+                errors.append(f"disagg smoke: prefill counter {k} never "
+                              f"moved ({pc.get(k, 0)})")
+        if de.stats()["kv_tier"]["restores"] < 1:
+            errors.append("disagg smoke: decode replica never tier-restored "
+                          "a handed-off prefix")
+        if fleet.stats()["disagg"]["handoffs"] < 1:
+            errors.append("disagg smoke: fleet recorded no handoff")
+        # the prefill request's timeline carries the handoff event (stamped
+        # post-retirement, so it must land on the RETIRED trace)
+        names = set()
+        for rid in range(12):
+            tree = pe.export_request_trace(rid)
+            if isinstance(tree, dict):
+                names |= {e.get("name") for e in tree.get("traceEvents", ())}
+        if "handoff" not in names:
+            errors.append(f"disagg smoke: no 'handoff' timeline event on "
+                          f"any prefill request trace (saw {sorted(names)})")
+        # role-labeled health through the front door
+        try:
+            with urllib.request.urlopen(door.url + "/healthz",
+                                        timeout=10) as r:
+                health = json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            health = json.loads(e.read())
+        per = health.get("engines", {})
+        got = {l: per.get(l, {}).get("role") for l in fleet.engines}
+        want = {l: fleet.engines[l].role for l in fleet.engines}
+        if got != want:
+            errors.append(f"front-door /healthz per-engine roles {got} != "
+                          f"{want}")
+    finally:
+        door.close()
+        fleet.stop()
+
+
 def main() -> int:
     errors = []
     eng, st = run_smoke(errors)
@@ -719,6 +807,7 @@ def main() -> int:
     check_merge_and_fleet(eng, errors)
     check_obs_server(eng, rid, errors)
     check_front_door(errors)
+    check_disagg(errors)
 
     # observability must be free of compiled programs: decode-side budget
     # unchanged — the bound comes from the registry (declared ONCE) so this

@@ -33,6 +33,17 @@ replica's counts stay inside the SAME single-engine budget and the
 executable objects are asserted literally identical
 (`EngineFleet.shared_executables`), not merely equal in number.
 
+A fourth pass measures a disaggregated 1P:1D `EngineFleet` (ISSUE 17):
+prefill/decode role separation moves KV between engines through the durable
+host/disk tier store — pure host-side numpy + npz, so the handoff must mint
+ZERO compiled programs.  The prefill replica's export rides the same warmed
+swap-out gather and the decode replica's restore rides the same warmed
+swap-in scatter that preemption parking declared, so BOTH role replicas
+measure inside the unchanged single-engine budget with the executable
+objects literally shared (leader adoption, same mesh) — and the pass
+asserts at least one handoff actually crossed the store, so a silent
+degrade to colocated serving cannot fake compliance.
+
 Runs the bench_serve CPU smoke (chunked prefill + prefix cache + speculative
 decoding — every lane the scheduler can dispatch) and exits non-zero with a
 diff against the budget on violation.
@@ -140,6 +151,56 @@ def measure_fleet(replicas=2):
     return per, fleet.shared_executables()
 
 
+def measure_disagg():
+    """Disaggregated serving adds ZERO programs: a 1P:1D role fleet serving
+    a 2-session x 2-turn conversation stream (every returning turn is a
+    store handoff: prefill exports through the durable tier, decode
+    tier-restores) must keep BOTH role replicas' executable counts inside
+    the single-engine budget with the compiled objects literally shared.
+    Returns ({label: counts}, shared_executables, handoffs)."""
+    import jax
+    import numpy as np
+
+    from paddle_tpu.inference.router import EngineFleet
+    from paddle_tpu.models import gpt as G
+
+    cfg = G.gpt_tiny(64)
+    params = G.init_params(cfg, jax.random.key(11))
+    fleet = EngineFleet(params, cfg, roles="P:D",
+                        engine_kwargs=dict(num_slots=2, page_size=8,
+                                           max_model_len=64,
+                                           prefill_chunk=16, spec_len=4,
+                                           seed=11))
+    fleet.warm()
+    rng = np.random.RandomState(11)
+    convs = [list(rng.randint(0, cfg.vocab_size, (18,)).astype(np.int32))
+             for _ in range(2)]
+    with fleet:
+        for _turn in range(2):
+            for s in range(2):
+                h = fleet.submit(np.asarray(convs[s], np.int32),
+                                 session=f"s{s}", max_new_tokens=6)
+                out = fleet.result(h, timeout=120.0)
+                if out is None:
+                    raise RuntimeError("disagg program-count stream timed "
+                                       f"out on session s{s}")
+                convs[s] = convs[s] + list(out.token_ids)
+    per = {}
+    for label, eng in fleet.engines.items():
+        st = eng.stats()
+        got = {
+            "decode_side_executables": st["decode_executables"] +
+                                       st["verify_executables"],
+            "prefill_executables": st["prefill_executables"],
+            "copy_executables": st["copy_executables"],
+            "swap_executables": st["swap_executables"],
+        }
+        got["total_executables"] = sum(got.values())
+        per[f"{label}:{eng.role}"] = got
+    handoffs = fleet.stats()["disagg"]["handoffs"]
+    return per, fleet.shared_executables(), handoffs
+
+
 def main() -> int:
     rc = 0
     report = {"metric": "serve_compiled_program_count", "ok": True}
@@ -188,6 +249,35 @@ def main() -> int:
                 print(f"FAIL[fleet/{label}]: {k} = {g} exceeds documented "
                       f"budget {b} — dp replication must not widen the "
                       f"per-replica program set", file=sys.stderr)
+    # disagg pass: role separation must not widen the program set — the
+    # handoff is host-side store traffic riding the warmed swap bucket
+    dis_per, dis_shared, dis_handoffs = measure_disagg()
+    report["disagg"] = {"roles": "P:D", "budget": BUDGET,
+                        "shared_executables": dis_shared,
+                        "handoffs": dis_handoffs,
+                        "per_replica": dis_per,
+                        "ok": dis_shared and dis_handoffs >= 1}
+    if not dis_shared:
+        report["ok"] = False
+        rc = 1
+        print("FAIL[disagg]: role replicas are not sharing the leader's "
+              "compiled executables — disaggregation is minting duplicate "
+              "programs", file=sys.stderr)
+    if dis_handoffs < 1:
+        report["ok"] = False
+        rc = 1
+        print("FAIL[disagg]: no prefill->decode handoff crossed the store "
+              "(the pass degraded to colocated serving and proves nothing)",
+              file=sys.stderr)
+    for label, got in dis_per.items():
+        over = {k: (got[k], BUDGET[k]) for k in BUDGET if got[k] > BUDGET[k]}
+        if over:
+            report["ok"] = report["disagg"]["ok"] = False
+            rc = 1
+            for k, (g, b) in over.items():
+                print(f"FAIL[disagg/{label}]: {k} = {g} exceeds documented "
+                      f"budget {b} — the tier-store handoff must stay "
+                      f"host-side (zero new programs)", file=sys.stderr)
     print(json.dumps(report))
     return rc
 
