@@ -404,6 +404,7 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
         raise
 
     st = eng.stats()
+    at_rest = eng.at_rest_bytes()   # cached cost account, zero extra traces
     lat = st["latency"]     # engine-side lifecycle histograms, seconds
     # EMITTED decode tokens only — idle slots in ramp-up/drain iterations are
     # not useful work and would overstate throughput at low arrival rates
@@ -519,6 +520,13 @@ def run_serve_bench(config=None, *, num_requests=32, num_slots=4,
         "weight_dtype": st["weight_dtype"],
         "kv_dtype": st["kv_dtype"],
         "kv_pool_bytes": st["kv_pool_bytes"],
+        # vocab-sharded head surface: at-rest param placement per device from
+        # the engine's cached cost account (zero extra traces).  At mp>=2 the
+        # floor is replicated_bytes_per_device STRICTLY below the fp wte size
+        # — the "replicated embedding ceiling" this layout retired.
+        "replicated_bytes_per_device": at_rest["replicated_bytes_per_device"],
+        "sharded_bytes_per_device": at_rest["sharded_bytes_per_device"],
+        "wte_bytes": at_rest["wte_bytes"],
         "intake_swap_rejects": st["intake_swap_rejects"],
         "output_tokens": [list(map(int, o.token_ids))
                           for o in sorted(outs, key=order_key)],

@@ -72,19 +72,22 @@ SERVE_PROGRAM_BUDGET_MP: Dict[str, int] = {
 
 # Static HBM/collective ceilings over the SAME tiny audit engines the jaxpr
 # checks trace (`jaxpr_checks._build_engine`: gpt_tiny(64), 2 slots, page 8,
-# chunk 8, spec 2 — mp1 AND mp2).  Units are cost-model bytes (traced aval
-# bytes, `analysis/cost_model.py` — deterministic across backends, no XLA
-# padding).  These are the repo's memory yardstick: the quantized-KV arc
-# must shrink the pool term, the vocab-sharded-head arc must move `wte` out
-# of the replicated set — both show up HERE before any TPU run.
+# chunk 8, spec 2 — mp1, mp2 AND mp4, the mesh size where the sharded-head
+# win compounds).  Units are cost-model bytes (traced aval bytes,
+# `analysis/cost_model.py` — deterministic across backends, no XLA padding).
+# These are the repo's memory yardstick: the quantized-KV arc shrank the
+# pool term, the vocab-sharded-head arc moved `wte` out of the replicated
+# set — both show up HERE before any TPU run.
 SERVE_RESOURCE_BUDGET: Dict[str, object] = {
     # Per-buffer ceiling on bytes REPLICATED on every chip under mp (JXP006).
-    # The audit config's one big replicated buffer is the tied embedding/head
-    # `wte` (256 x 64 fp32 = 64 KiB); 2x covers it while still flagging any
-    # new replicated matrix of comparable size.  This ceiling names the
-    # 70B blocker: at GPT-3 vocab a replicated wte is 50304 x D x 2 bytes
-    # PER CHIP no matter how large the mesh — sharding it is ROADMAP item 5c.
-    "replicated_bytes_ceiling": 131072,
+    # RATCHETED with the vocab-sharded head (ISSUE-18): `wte` (256 x 64 fp32
+    # = 64 KiB, the former ceiling-setter and 70B blocker) now lives in the
+    # SHARDED column, and the largest replicated leaf left is a 512 B
+    # norm/bias vector — 4096 is 8x headroom over that while any replicated
+    # matrix (a re-replicated head at 64 KiB, even the tiny-config wte)
+    # fails immediately.  At GPT-3 vocab the retired ceiling was
+    # 50304 x D x 2 bytes PER CHIP no matter how large the mesh.
+    "replicated_bytes_ceiling": 4_096,
     # Per-executable modeled peak HBM (JXP008): argument bytes + the
     # donation-aware liveness watermark.  Measured 2026-08 at mp1/mp2
     # (fused 689k/762k, decode 676k/750k, chunk 633k/710k, bucketed
@@ -113,21 +116,36 @@ SERVE_RESOURCE_BUDGET: Dict[str, object] = {
         "fused_step_int8": 430_000,
     },
     # Per-executable collective bytes per step (JXP007), keyed by the FULL
-    # target name: only the mp2 programs may communicate at all (Megatron
-    # row-parallel all-reduces, 2/layer, plus the head-sharded attention's
-    # resharding permutes — measured fused 32768 B/step at L=2).  An mp1
-    # program with ANY collective, or an mp2 program absent from this table,
-    # is undeclared traffic and fails CI.
+    # target name: only the mp>1 programs may communicate at all.  The
+    # declared traffic per step is (a) the Megatron row-parallel all-reduces
+    # (proj + fc2, 2/layer), (b) the vocab-parallel embed's ONE hidden-sized
+    # psum (ISSUE-18 — the price of never holding a replicated wte), and
+    # (c) the sharded-argmax merge: one (value, index) scalar PAIR per row
+    # (pmax + pmin, 2 x 4 B x rows) — NEVER logits-sized.  Measured 2026-08
+    # on the audit config (L=2, f32): fused 20608 B/step (16384 layer
+    # psums + 4096 embed psum + 128 argmax pair), decode 2576,
+    # chunk/bucketed 10248, verify 7728 — budgets are measured + ~20%
+    # headroom, so a logits-wide allgather (32 KiB at even this toy vocab)
+    # fails immediately.  Collective payloads are LOGICAL bytes, so mp2 and
+    # mp4 share one measured account (per-chip shards halve, the summed
+    # traffic does not).  An mp1 program with ANY collective, or an mp>1
+    # program absent from this table, is undeclared traffic and fails CI.
     "collective_bytes_per_step": {
-        "serve.mp2.fused_step": 49_152,
+        "serve.mp2.fused_step": 24_576,
         # dequant is chip-local (scales shard with their weights/pages), so
-        # the quantized fused step carries exactly the fp program's
-        # Megatron traffic — measured 32768 B/step at L=2, same budget
-        "serve.mp2.fused_step_int8": 49_152,
-        "serve.mp2.decode": 8_192,
-        "serve.mp2.chunk_prefill": 24_576,
-        "serve.mp2.bucketed_prefill": 24_576,
-        "serve.mp2.verify": 20_480,
+        # the quantized fused step carries exactly the fp program's traffic
+        "serve.mp2.fused_step_int8": 24_576,
+        "serve.mp2.decode": 4_096,
+        "serve.mp2.chunk_prefill": 12_288,
+        "serve.mp2.bucketed_prefill": 12_288,
+        "serve.mp2.verify": 10_240,
+        # the mp4 audit pass (same logical payloads, see above)
+        "serve.mp4.fused_step": 24_576,
+        "serve.mp4.fused_step_int8": 24_576,
+        "serve.mp4.decode": 4_096,
+        "serve.mp4.chunk_prefill": 12_288,
+        "serve.mp4.bucketed_prefill": 12_288,
+        "serve.mp4.verify": 10_240,
     },
     # UNIFIED host-pool ceiling (JXP009): the bound
     # `LLMEngine.host_pool_bytes()` declares for EVERYTHING parked in host
@@ -144,11 +162,12 @@ SERVE_RESOURCE_BUDGET: Dict[str, object] = {
     # accounted alongside the fp one each pass; all four numbers below are
     # the declared side of the ISSUE-11 acceptance bars:
     # - int8 replicated per-buffer ceiling (JXP006 on the quantized at-rest
-    #   account): wte_q is 256 x 64 x 1 B = 16 KiB (+1 KiB row scales) — 4x
-    #   under the fp `wte` it replaces; 2x headroom like the fp ceiling.  A
-    #   quantized engine whose embedding silently re-materializes at fp
-    #   width blows through this immediately.
-    "replicated_bytes_ceiling_int8": 32_768,
+    #   account): ratcheted with the fp ceiling (ISSUE-18) — wte_q/wte_scale
+    #   shard with the vocab axis, so the quantized replicated remainder is
+    #   the same 512 B norm/bias vectors plus tiny fp32 scale leaves.  A
+    #   quantized embedding re-materializing replicated (16 KiB int8, 64 KiB
+    #   fp) blows through 4096 immediately.
+    "replicated_bytes_ceiling_int8": 4_096,
     # - int8 pool at-rest ceiling + minimum shrink ratio (JXP010): the fp
     #   pool is 72 KiB (2 x [2,9,8,4,16] f32), the int8 pool 22.5 KiB
     #   (int8 pages + per-token f32 scale lanes) — measured ratio 3.2x,
@@ -231,7 +250,7 @@ SERVE_SLO: Dict[str, object] = {
 # the bench (byte parity, dispatch counts, the stamp-count tracing account)
 # tightly and the wall-clock ratios loosely.
 SERVE_PERF_FLOORS: Dict[str, object] = {
-    "schema_version": 4,
+    "schema_version": 5,
     # every parity flag a bench run reports must be True — byte-exact greedy
     # parity is the one bar noise cannot excuse (kv_tier_parity: tier
     # restores must be bit-exact vs the --no-kv-tier re-prefill;
@@ -285,6 +304,14 @@ SERVE_PERF_FLOORS: Dict[str, object] = {
     # lock, or re-reads the whole store); measured CPU-smoke handoffs sit
     # in the tens of ms.  disagg_parity carries the deterministic side.
     "handoff_p99_ms_max": 5000.0,
+    # the vocab-sharded-head claim (schema v5, deterministic — leaf-shape
+    # arithmetic, no wall clock): on any mp >= 2 row the per-device
+    # replicated param bytes must sit STRICTLY below the fp `wte` size the
+    # row also reports — i.e. the embedding/head genuinely left the
+    # replicated column (a re-replicated head makes replicated >= wte
+    # by definition).  The JXP006 ratchet enforces the same invariant on
+    # the audit engines; this floor enforces it on every bench row.
+    "replicated_below_wte": True,
 }
 
 
@@ -331,6 +358,21 @@ PROGRAM_SOURCES: Tuple[ProgramSource, ...] = (
         "paddle_tpu/models/gpt.py", "prefill_paged",
         note="bucketed prefill's dense flash attention shard_mapped over mp "
              "(inside the serving prefill executable, no standalone program)"),
+    ProgramSource(
+        "paddle_tpu/models/gpt.py", "_embed",
+        note="vocab-parallel serving embed: masked local take + psum over "
+             "the vocab-sharded wte (inside the serving executables, no "
+             "standalone program)"),
+    ProgramSource(
+        "paddle_tpu/models/gpt.py", "sharded_argmax",
+        note="sharded argmax merge over vocab-sharded logits — per-chip "
+             "(value, global index) pair + pmax/pmin tie-break (inside the "
+             "serving executables, no standalone program)"),
+    ProgramSource(
+        "paddle_tpu/models/gpt.py", "sample_token",
+        note="sharded temperature/top-k pick: local top-k + k*mp all-gather "
+             "threshold + gumbel-argmax merge (inside the serving "
+             "executables, no standalone program)"),
     # ---- parallel trainers ------------------------------------------------
     ProgramSource(
         "paddle_tpu/parallel/ring_attention.py", "shard_map_compat",

@@ -495,14 +495,19 @@ class LLMEngine:
     `mp=N` (or an explicit `mesh` with an 'mp' axis) serves tensor-parallel
     over N chips: params are placed ONCE at init in the Megatron serving
     layout (`parallel.hybrid.serving_param_specs` — qkv/fc1 column-, proj/fc2
-    row-sharded, embedding/head replicated), the page pool shards on its KVH
+    row-sharded, embedding/head VOCAB-sharded with the packed qkv permuted
+    into the per-partition column layout), the page pool shards on its KVH
     axis (each chip holds kv_heads/mp heads of every page), and the paged
-    attention runs per-chip on the local head slice.  All scheduler state
-    (page tables, lengths, refcounts, prefix index) stays replicated host
-    memory — the paging/prefix/COW logic is mp-oblivious — and greedy outputs
-    are token-identical to single-chip serving.  Per-mesh-config the compiled
-    decode-side program count is unchanged: the ONE fused step program
-    (<= 2 with `fuse=False`).
+    attention runs per-chip on the local head slice.  The head never
+    materializes replicated [B, V] logits: the embed is a masked local
+    take + psum, the head matmul produces [.., V/mp] shards, and
+    argmax/top-k/sampling merge per-chip (value, global index) pairs on
+    device (`models.gpt.sharded_argmax` / `sample_token`).  All scheduler
+    state (page tables, lengths, refcounts, prefix index) stays replicated
+    host memory — the paging/prefix/COW logic is mp-oblivious — and greedy
+    outputs are token-identical to single-chip serving.  Per-mesh-config the
+    compiled decode-side program count is unchanged: the ONE fused step
+    program (<= 2 with `fuse=False`).
     """
 
     def __init__(self, params, config: gpt_mod.GPTConfig, *,
@@ -569,9 +574,20 @@ class LLMEngine:
                 raise ValueError(
                     f"mp={self.mp} must divide num_heads "
                     f"({config.num_heads}) and kv_heads ({config.kv_heads})")
-            # place the serving params ONCE at init: Megatron block layout,
-            # embedding/head replicated (parallel.hybrid.serving_param_specs)
-            from ..parallel.hybrid import serving_param_specs
+            if config.vocab_size % self.mp:
+                raise ValueError(
+                    f"mp={self.mp} must divide vocab_size "
+                    f"({config.vocab_size}) — the embedding/head shard over "
+                    f"the vocab axis")
+            # place the serving params ONCE at init: Megatron block layout
+            # with the embedding/head VOCAB-SHARDED
+            # (parallel.hybrid.serving_param_specs); the packed qkv leaves
+            # are permuted into the per-partition column layout first so each
+            # chip's shard lands exactly on its own head slices — no
+            # replicate→reslice staging at placement or inside the step
+            from ..parallel.hybrid import (pack_qkv_partitions,
+                                           serving_param_specs)
+            params = pack_qkv_partitions(params, config, self.mp)
             specs = serving_param_specs(config, params)
             self._param_shardings = jax.tree_util.tree_map(
                 lambda s: jsh.NamedSharding(mesh, s), specs,
@@ -946,27 +962,29 @@ class LLMEngine:
         self._sample = sample
         self._temperature = temperature
 
+        cfg = config
+        mesh_ = mesh if self.mp > 1 else None
+        pool_sh = self._pool_sharding
+
         if sample:
             def pick(logits, key, greedy):
                 # gpt.sample_token is shared with generate() — parity by
                 # construction; the greedy mask routes per-request
                 # temperature=0.0 slots through argmax (their output is
-                # PRNG-independent; the batch-wide split still advances)
+                # PRNG-independent; the batch-wide split still advances).
+                # Under mp the logits arrive vocab-sharded and both picks
+                # run as on-device sharded merges.
                 ids, key = gpt_mod.sample_token(logits, key, sample=True,
                                                 temperature=temperature,
-                                                top_k=top_k)
-                greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                                                top_k=top_k, mesh=mesh_)
+                greedy_ids = gpt_mod.sharded_argmax(logits, mesh_)
                 return jnp.where(greedy, greedy_ids, ids), key
         else:
             def pick(logits, key, greedy):
                 # fully greedy engine: argmax, the PRNG key is never consumed
                 return gpt_mod.sample_token(logits, key, sample=False,
                                             temperature=temperature,
-                                            top_k=top_k)
-
-        cfg = config
-        mesh_ = mesh if self.mp > 1 else None
-        pool_sh = self._pool_sharding
+                                            top_k=top_k, mesh=mesh_)
 
         def pin_pool(pool):
             # pin the output pool to EXACTLY the committed input sharding (the
@@ -1006,8 +1024,7 @@ class LLMEngine:
             logits, pool = gpt_mod.verify_step_paged(params, tokens, pool,
                                                      table, lengths, valid,
                                                      cfg, mesh=mesh_)
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), \
-                pin_pool(pool)
+            return gpt_mod.sharded_argmax(logits, mesh_), pin_pool(pool)
 
         temp_, topk_ = temperature, top_k
 
@@ -2690,6 +2707,31 @@ class LLMEngine:
         same token geometry in ~2-4x fewer bytes)."""
         return int(sum(int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
                        for a in self._pool.values()))
+
+    def at_rest_bytes(self) -> Dict[str, int]:
+        """Cached at-rest memory account for this engine's params, classified
+        by the serving layout (`analysis.cost_model.params_at_rest` over
+        `serving_param_specs` — the SAME account `tools/tpu_cost.py` audits
+        under JXP006): `{replicated_bytes_per_device, sharded_bytes_per_device,
+        wte_bytes}`.  Host-side arithmetic over leaf shapes — no trace, no
+        dispatch, no new executable — so bench rows report the sharded-head
+        memory win for free.  `wte_bytes` is the FP embedding-table size (the
+        pre-shard replicated ceiling this layout retired): at mp>1 the
+        per-device replicated remainder must sit strictly below it."""
+        if getattr(self, "_at_rest_bytes", None) is None:
+            from ..analysis.cost_model import AtRestAccount, params_at_rest
+            a = AtRestAccount(max(self.mp, 1),
+                              params_at_rest(self.params, self.config,
+                                             self.mp))
+            c = self.config
+            wte_bytes = int(c.vocab_size * c.hidden_size
+                            * np.dtype(c.dtype).itemsize)
+            self._at_rest_bytes = {
+                "replicated_bytes_per_device": int(a.param_bytes_replicated),
+                "sharded_bytes_per_device": int(a.param_bytes_sharded_per_device),
+                "wte_bytes": wte_bytes,
+            }
+        return dict(self._at_rest_bytes)
 
     # ---- health & perf signal plane ---------------------------------------
     @property

@@ -374,15 +374,58 @@ def _w(bp, name, dtype):
     return _deq(q, bp[name + "_scale"], dtype)
 
 
-def _embed(params, tokens, config: GPTConfig):
+def _mesh_mp(mesh) -> int:
+    """Tensor-parallel degree of a serving mesh (1 when mesh is None or has
+    no "mp" axis) — the one switch the mp-aware serving fns key off."""
+    if mesh is None:
+        return 1
+    return int(mesh.shape.get("mp", 1))
+
+
+def _embed(params, tokens, config: GPTConfig, mesh=None):
     """Token-table lookup, weight-quantization aware: int8 `wte_q` rows are
     gathered first and dequantized by their per-row scale — the fp table is
-    never materialized."""
-    if "wte_q" in params:
-        rows = jnp.take(params["wte_q"], tokens, axis=0)
-        scale = jnp.take(params["wte_scale"], tokens, axis=0)
-        return _deq(rows, scale, config.dtype)
-    return jnp.take(params["wte"], tokens, axis=0)
+    never materialized.
+
+    Under an mp serving mesh the table is VOCAB-SHARDED (`wte` rows split
+    over "mp" by `parallel.hybrid.serving_param_specs`), and the lookup runs
+    as the Megatron vocab-parallel form — masked LOCAL take + psum inside a
+    manual region, mirroring the trainer's `_vp_embed` — because a
+    vocab-sharded gather under auto axes CHECK-crashes XLA's SPMD
+    partitioner.  Exactly one shard owns each token id, so the psum of
+    masked rows is bit-exact vs the replicated take."""
+    mp = _mesh_mp(mesh)
+    if mp <= 1:
+        if "wte_q" in params:
+            rows = jnp.take(params["wte_q"], tokens, axis=0)
+            scale = jnp.take(params["wte_scale"], tokens, axis=0)
+            return _deq(rows, scale, config.dtype)
+        return jnp.take(params["wte"], tokens, axis=0)
+
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.ring_attention import shard_map_compat
+    quant = "wte_q" in params
+
+    def local(table, scale, tok):
+        r = jax.lax.axis_index("mp")
+        Vl = table.shape[0]
+        ids = tok - r * Vl
+        ok = (ids >= 0) & (ids < Vl)
+        safe = jnp.clip(ids, 0, Vl - 1)
+        rows = jnp.take(table, safe, axis=0)
+        if quant:
+            rows = _deq(rows, jnp.take(scale, safe, axis=0), config.dtype)
+        rows = jnp.where(ok[..., None], rows, jnp.zeros((), rows.dtype))
+        return jax.lax.psum(rows, "mp")
+
+    sm = shard_map_compat(
+        local, mesh=mesh, axis_names={"mp"},
+        in_specs=(P("mp", None), P("mp", None), P()), out_specs=P())
+    if quant:
+        return sm(params["wte_q"], params["wte_scale"], tokens)
+    # fp path: feed the scale slot a zero-width view so one signature serves
+    # both dtypes (the branch is static, the dummy is dead code when traced).
+    return sm(params["wte"], params["wte"][:, :0], tokens)
 
 
 def head_matrix(params, config: GPTConfig):
@@ -397,7 +440,7 @@ def head_matrix(params, config: GPTConfig):
     return params["lm_head"]
 
 
-def head_logits(x, params, config: GPTConfig):
+def head_logits(x, params, config: GPTConfig, mesh=None):
     """Vocab projection `x @ head` for the serving executables.
 
     Quantization-aware WITHOUT materializing the fp [V, D] table inside the
@@ -406,16 +449,63 @@ def head_logits(x, params, config: GPTConfig):
     compute dtype — int8 values are exact in bf16/f32 — and the per-vocab
     scales multiply the LOGITS columns afterward, which is the same math
     because the scale is constant along the contraction dim.  The transient
-    is logits-shaped, not weight-shaped."""
+    is logits-shaped, not weight-shaped.
+
+    Under an mp mesh the head weight arrives VOCAB-SHARDED over "mp"
+    (`serving_param_specs`), the matmul partitions as a plain local GEMM
+    against the shard (matmuls — unlike gathers — partition fine under auto
+    GSPMD), and the constraint pins the logits' vocab axis sharded so each
+    chip holds [.., V/mp] and the replicated [.., V] buffer NEVER
+    materializes; the downstream pick merges per-shard (value, index) pairs
+    (`sharded_argmax` / `sample_token`)."""
     if config.tie_word_embeddings and "wte_q" in params:
         scale = params["wte_scale"].T                       # [V, 1] -> [1, V]
-        return (jnp.matmul(x, params["wte_q"].T.astype(config.dtype))
-                * scale).astype(config.dtype)
-    if not config.tie_word_embeddings and "lm_head_q" in params:
+        logits = (jnp.matmul(x, params["wte_q"].T.astype(config.dtype))
+                  * scale).astype(config.dtype)
+    elif not config.tie_word_embeddings and "lm_head_q" in params:
         scale = params["lm_head_scale"]                     # already [1, V]
-        return (jnp.matmul(x, params["lm_head_q"].astype(config.dtype))
-                * scale).astype(config.dtype)
-    return jnp.matmul(x, head_matrix(params, config))
+        logits = (jnp.matmul(x, params["lm_head_q"].astype(config.dtype))
+                  * scale).astype(config.dtype)
+    else:
+        logits = jnp.matmul(x, head_matrix(params, config))
+    if _mesh_mp(mesh) > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        spec = P(*([None] * (logits.ndim - 1)), "mp")
+        logits = jax.lax.with_sharding_constraint(
+            logits, NamedSharding(mesh, spec))
+    return logits
+
+
+def sharded_argmax(logits, mesh=None):
+    """First-occurrence argmax over the vocab (last) axis, mp-aware.
+
+    mesh None / mp=1 is plain `jnp.argmax`.  Under an mp mesh the logits
+    arrive vocab-sharded and each chip reduces its local shard to a
+    (value, global index) pair; a pmax merges the value and the tie-break
+    takes the LOWEST global index among the shards holding the max (pmin
+    over index-where-max, V as the sentinel) — exactly `jnp.argmax`'s
+    first-occurrence rule, so mp∈{1,2,4} emit byte-identical tokens.  The
+    merge runs in a manual region and moves one scalar pair per row over
+    the mesh — the replicated [.., V] logits buffer never exists."""
+    if _mesh_mp(mesh) <= 1:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.ring_attention import shard_map_compat
+    V = logits.shape[-1]
+    lead = logits.ndim - 1
+
+    def local(lg):
+        r = jax.lax.axis_index("mp")
+        Vl = lg.shape[-1]
+        lv = jnp.max(lg, axis=-1)
+        li = jnp.argmax(lg, axis=-1).astype(jnp.int32) + r * Vl
+        gm = jax.lax.pmax(lv, "mp")
+        cand = jnp.where(lv == gm, li, V)
+        return jax.lax.pmin(cand, "mp").astype(jnp.int32)
+
+    return shard_map_compat(
+        local, mesh=mesh, axis_names={"mp"},
+        in_specs=(P(*([None] * lead), "mp"),), out_specs=P())(logits)
 
 
 def backbone(params, tokens, config: GPTConfig, mp_constraint=None, remat=False,
@@ -631,12 +721,39 @@ def _ffn_dense(bp, h, c: GPTConfig, mp_constraint=None):
     return out
 
 
-def _decode_qkv(bp, x, c: GPTConfig, pos):
+def _unpack_qkv(qkv, c: GPTConfig, parts: int = 1):
+    """Split a packed qkv matmul output into flat q/k/v column groups,
+    partition-aware.
+
+    parts=1 is the trainer's global `[q | k | v]` layout.  parts=mp reads
+    the PER-PARTITION layout `[q_0 k_0 v_0 | q_1 k_1 v_1 | ...]` the engine
+    places under mp (`parallel.hybrid.pack_qkv_partitions`), whose `parts`
+    contiguous column groups are exactly each chip's head slices — so the
+    placed qkv shard is consumed where it lands, with no replicate→reslice
+    staging.  Concatenating the per-partition q (then k, then v) segments
+    restores GLOBAL head order, so for matching permutations the result is
+    bit-identical to the parts=1 unpack of the unpermuted weight; every
+    reshape/slice here moves along locally-owned axes (the packed column
+    axis shards evenly over `parts`), so under GSPMD the unpack is free."""
+    H, KVH, hd = c.num_heads, c.kv_heads, c.head_dim
+    if parts <= 1:
+        return jnp.split(qkv, [H * hd, (H + KVH) * hd], axis=-1)
+    lead = qkv.shape[:-1]
+    Hl, KVHl = H // parts, KVH // parts
+    g = qkv.reshape(*lead, parts, (Hl + 2 * KVHl) * hd)
+    q = g[..., :Hl * hd].reshape(*lead, H * hd)
+    k = g[..., Hl * hd:(Hl + KVHl) * hd].reshape(*lead, KVH * hd)
+    v = g[..., (Hl + KVHl) * hd:].reshape(*lead, KVH * hd)
+    return q, k, v
+
+
+def _decode_qkv(bp, x, c: GPTConfig, pos, parts: int = 1):
     """Pre-norm + packed qkv + rope for a single-token decode input.
 
     x [B, D]; pos is a scalar (dense contiguous cache) or a [B] vector
     (per-slot positions, the paged engine's slot-indexed decode).
-    Returns post-rope q [B, H, hd], k, v [B, KVH, hd]."""
+    Returns post-rope q [B, H, hd], k, v [B, KVH, hd].  `parts` selects the
+    packed-qkv column layout (`_unpack_qkv`)."""
     B = x.shape[0]
     H, KVH, hd = c.num_heads, c.kv_heads, c.head_dim
     h = _norm(x, bp["ln1_w"], bp["ln1_b"], c) if c.norm_position == "pre" \
@@ -644,7 +761,7 @@ def _decode_qkv(bp, x, c: GPTConfig, pos):
     qkv = jnp.matmul(h, _w(bp, "qkv_w", c.dtype))
     if "qkv_b" in bp:
         qkv = qkv + bp["qkv_b"]
-    q, k, v = jnp.split(qkv, [H * hd, (H + KVH) * hd], axis=-1)
+    q, k, v = _unpack_qkv(qkv, c, parts)
     q = q.reshape(B, H, hd)
     k = k.reshape(B, KVH, hd)
     v = v.reshape(B, KVH, hd)
@@ -669,10 +786,11 @@ def _rope_tables_at(config, pos):
     return jnp.sin(freqs), jnp.cos(freqs)
 
 
-def _prefill_qkv(bp, x, c: GPTConfig, pos=None):
+def _prefill_qkv(bp, x, c: GPTConfig, pos=None, parts: int = 1):
     """Pre-norm + packed qkv + rope over a [B, T, D] prompt (positions
     0..T-1, or explicit per-batch positions `pos` [B, T] for chunked
-    prefill).  Returns post-rope q [B, T, H, hd], k, v [B, T, KVH, hd]."""
+    prefill).  Returns post-rope q [B, T, H, hd], k, v [B, T, KVH, hd].
+    `parts` selects the packed-qkv column layout (`_unpack_qkv`)."""
     B, T, _ = x.shape
     H, KVH, hd = c.num_heads, c.kv_heads, c.head_dim
     h = _norm(x, bp["ln1_w"], bp["ln1_b"], c) if c.norm_position == "pre" \
@@ -680,7 +798,7 @@ def _prefill_qkv(bp, x, c: GPTConfig, pos=None):
     qkv = jnp.matmul(h, _w(bp, "qkv_w", c.dtype))
     if "qkv_b" in bp:
         qkv = qkv + bp["qkv_b"]
-    q, k, v = jnp.split(qkv, [H * hd, (H + KVH) * hd], axis=-1)
+    q, k, v = _unpack_qkv(qkv, c, parts)
     q = q.reshape(B, T, H, hd)
     k = k.reshape(B, T, KVH, hd)
     v = v.reshape(B, T, KVH, hd)
@@ -902,7 +1020,8 @@ def decode_step_paged(params, tokens, cache, page_table, lengths,
     quant = "k_scale" in cache          # int8 pool: quantize writes in-program
     pos = lengths
     pin = serving_mp_constraint(mesh)
-    x = _embed(params, tokens, c)                            # [B, D]
+    parts = _mesh_mp(mesh)
+    x = _embed(params, tokens, c, mesh=mesh)                 # [B, D]
     if not c.use_rope:
         x = x + jnp.take(params["wpe"], pos, axis=0)
     page_idx = jnp.take_along_axis(page_table, (pos // page)[:, None],
@@ -911,7 +1030,7 @@ def decode_step_paged(params, tokens, cache, page_table, lengths,
 
     def layer(x, layer_in):
         bp, kv = layer_in                   # kv pool slices [P, page, KVH, hd]
-        q, k, v = _decode_qkv(bp, x, c, pos)
+        q, k, v = _decode_qkv(bp, x, c, pos, parts=parts)
         if pin:
             q, k, v = pin(q, "heads"), pin(k, "heads"), pin(v, "heads")
         if quant:
@@ -930,7 +1049,7 @@ def decode_step_paged(params, tokens, cache, page_table, lengths,
     x, new_cache = jax.lax.scan(
         lambda carry, inp: layer(carry, inp), x, (params["blocks"], cache))
     x = epilogue(params, x, c)
-    return head_logits(x, params, c), new_cache
+    return head_logits(x, params, c, mesh=mesh), new_cache
 
 
 def prefill_paged(params, input_ids, config: GPTConfig, cache, pages, length,
@@ -956,7 +1075,8 @@ def prefill_paged(params, input_ids, config: GPTConfig, cache, pages, length,
     n_chunks = Sb // page
     quant = "k_scale" in cache
     pin = serving_mp_constraint(mesh)
-    x = _embed(params, input_ids, c)
+    parts = _mesh_mp(mesh)
+    x = _embed(params, input_ids, c, mesh=mesh)
     if not c.use_rope:
         x = x + params["wpe"][:Sb]
 
@@ -975,7 +1095,7 @@ def prefill_paged(params, input_ids, config: GPTConfig, cache, pages, length,
 
     def layer(x, layer_in):
         bp, kv = layer_in
-        q, k, v = _prefill_qkv(bp, x, c)
+        q, k, v = _prefill_qkv(bp, x, c, parts=parts)
         if pin:
             q, k, v = pin(q, "heads"), pin(k, "heads"), pin(v, "heads")
         # the dense in-chunk attention below reads the FULL-precision k/v —
@@ -1007,7 +1127,7 @@ def prefill_paged(params, input_ids, config: GPTConfig, cache, pages, length,
         lambda carry, inp: layer(carry, inp), x, (params["blocks"], cache))
     x = x[jnp.arange(B), length - 1]                 # last real position
     x = epilogue(params, x, c)
-    return head_logits(x, params, c), new_cache
+    return head_logits(x, params, c, mesh=mesh), new_cache
 
 
 def _paged_chunk_hidden(params, input_ids, config: GPTConfig, cache,
@@ -1032,9 +1152,10 @@ def _paged_chunk_hidden(params, input_ids, config: GPTConfig, cache,
     page = cache["k"].shape[2]
     quant = "k_scale" in cache
     pin = serving_mp_constraint(mesh)
+    parts = _mesh_mp(mesh)
     pos = q_offset[:, None] + jnp.arange(C)                  # [B, C]
     real = jnp.arange(C)[None, :] < valid[:, None]           # [B, C]
-    x = _embed(params, input_ids, c)
+    x = _embed(params, input_ids, c, mesh=mesh)
     if not c.use_rope:
         # jnp.take clips padded-tail positions past wpe; their rows are junk
         # the scheduler never reads (rows >= valid are never consumed)
@@ -1045,7 +1166,7 @@ def _paged_chunk_hidden(params, input_ids, config: GPTConfig, cache,
 
     def layer(x, layer_in):
         bp, kv = layer_in
-        q, k, v = _prefill_qkv(bp, x, c, pos=pos)
+        q, k, v = _prefill_qkv(bp, x, c, pos=pos, parts=parts)
         if pin:
             q, k, v = pin(q, "heads"), pin(k, "heads"), pin(v, "heads")
         if quant:
@@ -1089,7 +1210,7 @@ def prefill_chunk_paged(params, input_ids, config: GPTConfig, cache,
                                    page_table, q_offset, valid, mesh=mesh)
     x = x[jnp.arange(B), valid - 1]                  # last real chunk position
     x = epilogue(params, x, config)
-    return head_logits(x, params, config), cache
+    return head_logits(x, params, config, mesh=mesh), cache
 
 
 def verify_step_paged(params, tokens, cache, page_table, lengths, valid,
@@ -1121,7 +1242,7 @@ def verify_step_paged(params, tokens, cache, page_table, lengths, valid,
                                    attn_entry=paged_verify_attention,
                                    mesh=mesh)
     x = epilogue(params, x, config)
-    return head_logits(x, params, config), cache
+    return head_logits(x, params, config, mesh=mesh), cache
 
 
 def serve_step_paged(params, tokens, cache, page_table, q_offset, valid,
@@ -1163,8 +1284,8 @@ def serve_step_paged(params, tokens, cache, page_table, q_offset, valid,
                                    attn_entry=paged_serve_attention,
                                    mesh=mesh)
     x = epilogue(params, x, config)
-    logits = head_logits(x, params, config)                   # [B, T, V]
-    out = jnp.argmax(logits, axis=-1).astype(jnp.int32)       # [B, T]
+    logits = head_logits(x, params, config, mesh=mesh)  # [B, T, V] (V/mp ea.)
+    out = sharded_argmax(logits, mesh)                        # [B, T]
     B, T = tokens.shape
     rows = jnp.arange(B)
     if sample:
@@ -1173,7 +1294,8 @@ def serve_step_paged(params, tokens, cache, page_table, q_offset, valid,
         # discipline); the greedy mask routes temperature=0.0 requests to the
         # argmax already in `out`, so their tokens stay PRNG-independent
         ids, key = sample_token(logits[rows, valid - 1], key, sample=True,
-                                temperature=temperature, top_k=top_k)
+                                temperature=temperature, top_k=top_k,
+                                mesh=mesh)
         pick = jnp.where(greedy, out[rows, valid - 1], ids)
         out = out.at[rows, valid - 1].set(pick)
     # greedy longest-prefix acceptance, on device: drafted token t+1 is
@@ -1231,20 +1353,58 @@ def generate_cache_stats():
             "max_size": GENERATE_CACHE_MAX}
 
 
-def sample_token(logits, key, *, sample, temperature, top_k):
+def sample_token(logits, key, *, sample, temperature, top_k, mesh=None):
     """Greedy argmax or temperature/top-k sample over [B, V] logits.
 
     The ONE sampling implementation shared by `generate` and the serving
     engine (`inference.engine.LLMEngine`) so their outputs cannot drift.
-    `temperature` may be a traced scalar.  Returns (ids [B] int32, key)."""
+    `temperature` may be a traced scalar.  Returns (ids [B] int32, key).
+
+    The categorical draw is written as the gumbel-argmax identity
+    (`categorical(key, lg) == argmax(lg + gumbel(key, lg.shape))` — the same
+    construction jax.random.categorical uses) so the mp1 and vocab-sharded
+    paths are the SAME math on the same noise: under an mp mesh (logits
+    arrive [.., V/mp]-sharded from `head_logits`) the full-width noise is
+    deterministic per (key, element) regardless of sharding, each chip adds
+    the slice it owns, a top-k threshold merges per-chip local top-ks (one
+    k·mp-scalar all-gather per row — never the logits), and the pick is the
+    deterministic (value, global index) merge of `sharded_argmax`.  Fixed
+    key ⇒ byte-identical ids across mp∈{1,2,4} by construction."""
     if sample:
         key, sub = jax.random.split(key)
         lg = logits / temperature
-        if top_k:
-            kth = jax.lax.top_k(lg, top_k)[0][:, -1:]
-            lg = jnp.where(lg < kth, -1e30, lg)
-        return jax.random.categorical(sub, lg).astype(jnp.int32), key
-    return jnp.argmax(logits, axis=-1).astype(jnp.int32), key
+        noise = jax.random.gumbel(sub, lg.shape, lg.dtype)
+        if _mesh_mp(mesh) <= 1:
+            if top_k:
+                kth = jax.lax.top_k(lg, top_k)[0][:, -1:]
+                lg = jnp.where(lg < kth, -1e30, lg)
+            return jnp.argmax(lg + noise, axis=-1).astype(jnp.int32), key
+
+        from jax.sharding import PartitionSpec as P
+        from ..parallel.ring_attention import shard_map_compat
+        V = lg.shape[-1]
+        kk = int(top_k) if top_k else 0
+
+        def local(lg_l, nz_l):
+            r = jax.lax.axis_index("mp")
+            Vl = lg_l.shape[-1]
+            if kk:
+                mine = jax.lax.top_k(lg_l, min(kk, Vl))[0]
+                allk = jax.lax.all_gather(mine, "mp", axis=-1, tiled=True)
+                kth = jax.lax.top_k(allk, kk)[0][:, -1:]
+                lg_l = jnp.where(lg_l < kth, -1e30, lg_l)
+            g = lg_l + nz_l
+            lv = jnp.max(g, axis=-1)
+            li = jnp.argmax(g, axis=-1).astype(jnp.int32) + r * Vl
+            gm = jax.lax.pmax(lv, "mp")
+            cand = jnp.where(lv == gm, li, V)
+            return jax.lax.pmin(cand, "mp").astype(jnp.int32)
+
+        ids = shard_map_compat(
+            local, mesh=mesh, axis_names={"mp"},
+            in_specs=(P(None, "mp"), P(None, "mp")), out_specs=P())(lg, noise)
+        return ids, key
+    return sharded_argmax(logits, mesh), key
 
 
 def generate(params, input_ids, config: GPTConfig, max_new_tokens: int = 32,

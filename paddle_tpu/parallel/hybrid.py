@@ -140,22 +140,27 @@ def serving_param_specs(model_config, params):
     """PartitionSpec tree (congruent with `params`) for tensor-parallel
     serving: the trainer's Megatron block layout (`gpt_param_specs` with the
     pp/ep axes off — qkv/fc1/fcg column-split, proj/fc2 row-split) over an
-    ("mp",) serving mesh, with the embedding/head/final-norm replicated.
+    ("mp",) serving mesh, with the embedding table and LM head VOCAB-SHARDED
+    (`wte` rows / `lm_head` columns split over "mp", the Megatron
+    vocab-parallel layout — ref fleet/layers/mpu.py).
 
-    Replicating the vocab table is deliberate: the serving path samples from
-    full [B, V] logits on the host every step, and a vocab-sharded head would
-    put an allgather (or a distributed argmax) on the latency-critical decode
-    dispatch; the transformer blocks — the bulk of the params at depth — are
-    what mp-sharding is for (per-chip block memory drops by mp×).
+    The vocab shard is what retires the repo's last replicated-memory
+    ceiling: since the fused step samples ON DEVICE, the head never needs
+    replicated [B, V] logits — the embed runs as a masked local take + psum
+    (`models.gpt._embed`, mirroring the trainer's `_vp_embed`), the head
+    matmul consumes the local shard producing [.., V/mp] logits, and the
+    argmax/top-k/sample pick merges per-shard (value, global index) pairs
+    (`models.gpt.sharded_argmax` / `sample_token`).  Only the tiny
+    position/norm vectors (wpe, lnf) remain replicated.
 
     Weight-quantized params (`quantization.serving.quantize_serving_params`)
-    replace a block weight with the `name_q` (int8) + `name_scale` (f32,
-    [L, 1, out]) pair: the int8 leaf keeps the fp weight's spec, and the
-    scale shards with the weight's CHANNEL (last) dim — sharded for the
-    column-parallel qkv/fc1/fcg (their scales split with the output
-    columns), replicated for the row-parallel proj/fc2 (whose output dim is
-    unsharded).  The quantized embedding/head pairs stay replicated like
-    the fp `wte`/`lm_head` they replace."""
+    replace a weight with the `name_q` (int8) + `name_scale` (f32) pair: the
+    int8 leaf keeps the fp weight's spec, and the scale shards WITH the
+    weight's quantization channel dim — block scales are [L, 1, out] and
+    split with column-parallel outputs (qkv/fc1/fcg), replicated for
+    row-parallel proj/fc2; the head pairs shard with their vocab dim
+    (`wte_scale` [V, 1] rows, `lm_head_scale` [1, V] columns), so dequant
+    stays a shard-local elementwise multiply."""
     base = gpt_param_specs(MeshConfig(mp=2), model_config)["blocks"]
 
     def block_spec(k):
@@ -167,10 +172,59 @@ def serving_param_specs(model_config, params):
             return P(None, None, "mp") if last is not None else P()
         return base.get(k, P())
 
+    vocab = {
+        # wte is [V, D] row-sharded; its int8 twin and [V, 1] scale follow.
+        "wte": P("mp", None), "wte_q": P("mp", None),
+        "wte_scale": P("mp", None),
+        # untied lm_head is [D, V] column-sharded; scale is [1, V].
+        "lm_head": P(None, "mp"), "lm_head_q": P(None, "mp"),
+        "lm_head_scale": P(None, "mp"),
+    }
     blocks = {k: block_spec(k) for k in params["blocks"]}
-    specs = {k: P() for k in params if k != "blocks"}
+    specs = {k: vocab.get(k, P()) for k in params if k != "blocks"}
     specs["blocks"] = blocks
     return specs
+
+
+def qkv_partition_perm(model_config, parts: int) -> np.ndarray:
+    """Column permutation taking the packed `[q | k | v]` qkv layout to the
+    per-partition `[q_0 k_0 v_0 | q_1 k_1 v_1 | ...]` layout whose `parts`
+    contiguous column groups are exactly each mp shard's head slices.
+
+    The trainer packs qkv as one [D, (H + 2*KVH) * hd] matmul with q, k, v
+    column groups laid out globally — under the serving spec
+    P(None, None, "mp") a contiguous split then lands q/k/v FRAGMENTS on
+    each chip and GSPMD must stage a replicate→reslice to reassemble the
+    per-head layout at the split points (ROADMAP item-3c's named blocker).
+    Permuting columns once at placement time makes the contiguous shard r
+    hold precisely [q_r | k_r | v_r]; the model-side unpack
+    (`models.gpt._unpack_qkv`) is partition-aware and restores GLOBAL head
+    order bit-exactly, so the permutation is invisible to outputs."""
+    H = model_config.num_heads
+    KVH = model_config.kv_heads
+    hd = model_config.head_dim
+    assert H % parts == 0 and KVH % parts == 0, (H, KVH, parts)
+    q = np.arange(H * hd).reshape(parts, -1)
+    k = H * hd + np.arange(KVH * hd).reshape(parts, -1)
+    v = (H + KVH) * hd + np.arange(KVH * hd).reshape(parts, -1)
+    return np.concatenate([q, k, v], axis=1).reshape(-1)
+
+
+def pack_qkv_partitions(params, model_config, parts: int):
+    """Permute every packed-qkv leaf (fp weight, bias, int8 twin + channel
+    scale) into the per-partition column layout (`qkv_partition_perm`), so
+    `device_put` under `serving_param_specs` lands each chip's qkv shard
+    without replicate→reslice staging.  `parts <= 1` is the identity."""
+    if parts <= 1:
+        return params
+    perm = qkv_partition_perm(model_config, parts)
+    blocks = dict(params["blocks"])
+    for k in ("qkv_w", "qkv_b", "qkv_w_q", "qkv_w_scale"):
+        if k in blocks:
+            blocks[k] = blocks[k][..., perm]
+    out = dict(params)
+    out["blocks"] = blocks
+    return out
 
 
 def _add_axis(spec: P, shape, axis_name: str, degree: int) -> P:

@@ -318,7 +318,7 @@ def test_bench_multi_turn_tier_acceptance(params, cfg):
         tier["outputs_digest"] == base["outputs_digest"]
     stats["returning_prefilled_drop"] = round(drop, 4)
     row = bench_row(stats)
-    assert row["schema_version"] == 4
+    assert row["schema_version"] == 5
     assert validate_row(row) == []
     assert check_floors(row) == []
     assert row["mode"]["kv_tier"] is True and row["mode"]["multi_turn"] == 3

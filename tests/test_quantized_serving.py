@@ -302,8 +302,10 @@ def test_serving_param_specs_quantized(params, cfg):
     assert blocks["fc1_w_scale"] == P(None, None, "mp")
     assert blocks["proj_w_scale"] == P()
     assert blocks["fc2_w_scale"] == P()
-    # embedding pair replicated like the fp wte
-    assert specs["wte_q"] == P() and specs["wte_scale"] == P()
+    # embedding pair vocab-sharded like the fp wte (scale rows ride the
+    # vocab axis: one scale per vocab row)
+    assert specs["wte_q"] == P("mp", None)
+    assert specs["wte_scale"] == P("mp", None)
 
 
 def test_cost_checks_quantized_clean():
@@ -314,8 +316,13 @@ def test_cost_checks_quantized_clean():
     rep = reports[1]
     assert rep["quantized_pool_ratio"] >= 2.0
     assert rep["at_rest_quantized"]["pool_bytes"] < rep["at_rest"]["pool_bytes"]
-    assert rep["at_rest_quantized"]["param_bytes_replicated"] < \
-        rep["at_rest"]["param_bytes_replicated"]
+    # int8 must shrink the TOTAL param account (the replicated remainder is
+    # the norm/bias tail plus the row-parallel scales, which int8 slightly
+    # grows — the win lives in the vocab-sharded + block columns; same
+    # comparison JXP010 enforces)
+    q, f = rep["at_rest_quantized"], rep["at_rest"]
+    assert q["param_bytes_sharded"] + q["param_bytes_replicated"] < \
+        f["param_bytes_sharded"] + f["param_bytes_replicated"]
     assert rep["host_pool_bytes_int8"] < rep["host_pool_bytes"]
     names = [p["name"] for p in rep["programs"]]
     assert "serve.fused_step_int8" in names

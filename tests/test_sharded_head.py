@@ -1,0 +1,247 @@
+"""Vocab-sharded serving head: sharded argmax/top-k merge goldens, cross-mp
+byte parity, and the ratcheted replicated-bytes account.
+
+The serving layout shards `wte`/`lm_head` (and their int8 twins) along the
+vocab axis (`parallel.hybrid.serving_param_specs`), keeps the `[B, T, V/mp]`
+logits sharded, and merges the pick on device: `sharded_argmax` reproduces
+`jnp.argmax`'s first-occurrence tie-break exactly (local max/argmax ->
+pmax -> index-min over the argmax-achieving shards), and `sample_token`'s
+top-k path computes the global k-th threshold from a tiled all-gather of the
+per-shard top-k.  Because the full-width Gumbel noise is drawn OUTSIDE the
+manual region, the sampled pick is bit-identical across mp — so mp1/mp2/mp4
+engines must emit BYTE-IDENTICAL tokens, greedy and sampled, fp and int8.
+
+JXP006 (`analysis.cost_model.audit_resources`) enforces the ratcheted
+per-buffer replicated ceiling this layout bought (registry:
+replicated_bytes_ceiling) — the pos/neg pair here injects budgets around the
+measured account so the ratchet cannot silently loosen.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models import gpt as G
+from paddle_tpu.parallel.hybrid import serving_mesh
+from paddle_tpu.inference.engine import LLMEngine
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = G.gpt_tiny(64)
+    return cfg, G.init_params(cfg, jax.random.key(0))
+
+
+def _mixed_prompts(cfg, seed=0):
+    """Mixed stream incl. a shared-prefix pair, so prefix cache + COW are on
+    the parity path (same shape as the fused-step suite's stream)."""
+    rng = np.random.RandomState(seed)
+    pat = rng.randint(0, cfg.vocab_size, (4,)).astype(np.int32)
+    prompts = [np.tile(pat, 3)]
+    prompts += [rng.randint(0, cfg.vocab_size, (n,)).astype(np.int32)
+                for n in (5, 9, 17, 30)]
+    prompts.append(np.concatenate(
+        [prompts[-1], rng.randint(0, cfg.vocab_size, (4,)).astype(np.int32)]))
+    return prompts
+
+
+# ---------------------------------------------------------------------------
+# unit goldens: the on-device merge vs the replicated reference
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mp", [2, 4])
+@pytest.mark.parametrize("shape", [(3, 64), (2, 3, 64)],
+                         ids=["decode2d", "verify3d"])
+def test_sharded_argmax_matches_replicated(mp, shape):
+    """Golden: the pmax/pmin merge equals `jnp.argmax` on random logits,
+    over both logits ranks the fused program produces."""
+    logits = jax.random.normal(jax.random.key(5), shape, jnp.float32)
+    mesh = serving_mesh(mp)
+    out = G.sharded_argmax(logits, mesh)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.asarray(jnp.argmax(logits, axis=-1)))
+    assert out.dtype == jnp.int32
+
+
+@pytest.mark.parametrize("mp", [2, 4])
+def test_sharded_argmax_tie_break_first_occurrence(mp):
+    """Determinism golden: constructed ties — equal maxima within one shard,
+    across shards, and in the last shard only — resolve to the LOWEST global
+    index, exactly `jnp.argmax`'s first-occurrence rule.  This is the rule
+    that makes mp1/mp2/mp4 greedy streams byte-identical."""
+    V = 64
+    rows = [
+        ([5, 37], 5),       # tie across shards (mp2: shard 0 vs 1) -> first
+        ([40, 8], 8),       # later shard listed first -> still global min
+        ([10, 12], 10),     # tie inside one shard
+        ([63], 63),         # max in the last shard only
+        ([0, 32, 48], 0),   # three-way tie spanning shards
+    ]
+    logits = np.zeros((len(rows), V), np.float32)
+    for r, (idxs, _) in enumerate(rows):
+        logits[r, idxs] = 1.0
+    out = np.asarray(G.sharded_argmax(jnp.asarray(logits), serving_mesh(mp)))
+    np.testing.assert_array_equal(out, [want for _, want in rows])
+    np.testing.assert_array_equal(
+        out, np.asarray(jnp.argmax(jnp.asarray(logits), axis=-1)))
+
+
+@pytest.mark.parametrize("top_k", [0, 7], ids=["full", "topk7"])
+@pytest.mark.parametrize("mp", [2, 4])
+def test_sharded_sample_token_matches_replicated(mp, top_k):
+    """Golden: `sample_token` under a mesh emits exactly the mp=1 pick for
+    the same key — the shared full-width Gumbel draw + the all-gathered
+    k-th-value threshold make the sharded pick bit-identical."""
+    logits = jax.random.normal(jax.random.key(9), (4, 64), jnp.float32)
+    key = jax.random.key(7)
+    ref, ref_key = G.sample_token(logits, key, sample=True, temperature=0.8,
+                                  top_k=top_k)
+    ids, new_key = G.sample_token(logits, key, sample=True, temperature=0.8,
+                                  top_k=top_k, mesh=serving_mesh(mp))
+    np.testing.assert_array_equal(np.asarray(ids), np.asarray(ref))
+    np.testing.assert_array_equal(jax.random.key_data(new_key),
+                                  jax.random.key_data(ref_key))
+
+
+# ---------------------------------------------------------------------------
+# engine parity: byte-identical streams across mesh sizes
+# ---------------------------------------------------------------------------
+
+def _greedy_tokens(params, cfg, prompts, mp, **kw):
+    eng = LLMEngine(params, cfg, num_slots=3, page_size=8, max_model_len=64,
+                    mp=mp if mp > 1 else None, **kw)
+    rids = [eng.add_request(p, max_new_tokens=8) for p in prompts]
+    res = eng.run()
+    return [list(res[r].tokens) for r in rids]
+
+
+def test_greedy_byte_parity_mp124(tiny):
+    """Acceptance bar: mp=1/2/4 engines emit BYTE-IDENTICAL greedy tokens in
+    the full serving mode (spec + chunked prefill, prefix cache + COW on) —
+    the vocab-sharded head and merge change nothing observable."""
+    cfg, params = tiny
+    prompts = _mixed_prompts(cfg)
+    outs = {mp: _greedy_tokens(params, cfg, prompts, mp,
+                               prefill_chunk=8, spec_len=3)
+            for mp in (1, 2, 4)}
+    assert outs[1] == outs[2] == outs[4]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("spec_len,chunk",
+                         [(0, None), (3, None), (0, 8)],
+                         ids=["plain", "spec", "chunked"])
+def test_greedy_byte_parity_mp124_mode_matrix(tiny, spec_len, chunk):
+    """The remaining serving modes of the 4-mode acceptance matrix (the
+    spec+chunk combination runs non-slow above)."""
+    cfg, params = tiny
+    prompts = _mixed_prompts(cfg)
+    outs = {mp: _greedy_tokens(params, cfg, prompts, mp,
+                               prefill_chunk=chunk, spec_len=spec_len)
+            for mp in (1, 2, 4)}
+    assert outs[1] == outs[2] == outs[4]
+
+
+def test_sampled_fixed_key_parity_mp12(tiny):
+    """Sampled path: a fixed seed emits identical token streams on mp=1 and
+    mp=2 engines (the PRNG streams split in lockstep; the sharded pick is
+    bit-identical per draw), with and without top-k."""
+    cfg, params = tiny
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (5, 12)]
+    for tk in (0, 7):
+        outs = {}
+        for mp in (1, 2):
+            eng = LLMEngine(params, cfg, num_slots=2, page_size=8,
+                            max_model_len=64, temperature=0.8, seed=42,
+                            top_k=tk or None, spec_len=0,
+                            mp=mp if mp > 1 else None)
+            rids = [eng.add_request(p, max_new_tokens=8) for p in prompts]
+            res = eng.run()
+            outs[mp] = [list(res[r].token_ids) for r in rids]
+        assert outs[1] == outs[2], f"sampled divergence at top_k={tk}"
+
+
+@pytest.mark.slow
+def test_sampled_fixed_key_parity_mp4(tiny):
+    cfg, params = tiny
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (5, 12)]
+    outs = {}
+    for mp in (1, 4):
+        eng = LLMEngine(params, cfg, num_slots=2, page_size=8,
+                        max_model_len=64, temperature=0.8, seed=42,
+                        top_k=7, spec_len=0, mp=mp if mp > 1 else None)
+        rids = [eng.add_request(p, max_new_tokens=8) for p in prompts]
+        res = eng.run()
+        outs[mp] = [list(res[r].token_ids) for r in rids]
+    assert outs[1] == outs[4]
+
+
+def test_int8_top1_agreement_mp12(tiny):
+    """int8 weights: quantization is applied BEFORE sharding, so the sharded
+    int8 head sees the same quantized table per vocab row and the greedy
+    (top-1) stream stays byte-identical across mesh sizes."""
+    cfg, params = tiny
+    prompts = _mixed_prompts(cfg)[:3]
+    outs = {mp: _greedy_tokens(params, cfg, prompts, mp,
+                               weight_dtype="int8")
+            for mp in (1, 2)}
+    assert outs[1] == outs[2]
+
+
+@pytest.mark.slow
+def test_int8_top1_agreement_mp4(tiny):
+    cfg, params = tiny
+    prompts = _mixed_prompts(cfg)[:3]
+    outs = {mp: _greedy_tokens(params, cfg, prompts, mp, weight_dtype="int8",
+                               prefill_chunk=8, spec_len=2)
+            for mp in (1, 4)}
+    assert outs[1] == outs[4]
+
+
+# ---------------------------------------------------------------------------
+# JXP006: the ratcheted replicated-bytes ceiling (pos/neg by injection)
+# ---------------------------------------------------------------------------
+
+def test_jxp006_ratchet_positive_and_negative(tiny):
+    """The measured mp=2 account passes the DECLARED (ratcheted) ceiling and
+    a squeezed injected ceiling flags the largest replicated leaf — proving
+    the declared number still bites; `wte`/`lm_head` must sit in the sharded
+    column, never among the JXP006 offenders."""
+    from paddle_tpu.analysis.cost_model import (AtRestAccount, params_at_rest,
+                                                audit_resources)
+    from paddle_tpu.analysis.registry import SERVE_RESOURCE_BUDGET
+
+    cfg, params = tiny
+    at_rest = AtRestAccount(2, params_at_rest(params, cfg, mp=2))
+    sharded = {b.name for b in at_rest.buffers if b.sharded}
+    assert "wte" in sharded          # tied head: wte doubles as lm_head
+
+    # negative: the declared ratchet holds on the measured account
+    _, findings = audit_resources([], at_rest, SERVE_RESOURCE_BUDGET,
+                                  compile_collectives=False)
+    assert [f for f in findings if f.rule == "JXP006"] == []
+
+    # positive: squeeze the ceiling below the largest replicated leaf —
+    # JXP006 must fire and must NOT name a vocab-sharded buffer
+    top = max((b for b in at_rest.buffers
+               if not b.sharded and not b.name.startswith("pool.")),
+              key=lambda b: b.bytes)
+    _, findings = audit_resources(
+        [], at_rest, {"replicated_bytes_ceiling": top.bytes - 1},
+        compile_collectives=False)
+    hits = [f for f in findings if f.rule == "JXP006"]
+    assert hits and any(f"`{top.name}`" in f.message for f in hits)
+    assert not any("wte" in f.message or "lm_head" in f.message
+                   for f in hits)
+
+    # mp=1 keeps replication free: the same squeezed ceiling stays silent
+    at_rest1 = AtRestAccount(1, params_at_rest(params, cfg, mp=1))
+    _, findings = audit_resources(
+        [], at_rest1, {"replicated_bytes_ceiling": 1},
+        compile_collectives=False)
+    assert [f for f in findings if f.rule == "JXP006"] == []

@@ -115,9 +115,10 @@ def test_scan_multiplies_body_flops():
 
 def test_at_rest_mp2_halves_sharded_keeps_replicated():
     """The mp=2 engine holds half the sharded param bytes and half the page
-    pool per device, with the replicated set (embedding/head, norms, row
-    biases) byte-identical to mp=1 — the memory math behind 'per-chip block
-    memory drops by mp x' and the JXP006 ceiling's denominator."""
+    pool per device, with the replicated set (norms, row biases — the
+    embedding/head now lives in the SHARDED column) byte-identical to mp=1 —
+    the memory math behind 'per-chip block memory drops by mp x' and the
+    JXP006 ceiling's denominator."""
     e1, _ = _build_engine(1)
     e2, _ = _build_engine(2)
     a1, a2 = engine_at_rest(e1), engine_at_rest(e2)
@@ -127,19 +128,31 @@ def test_at_rest_mp2_halves_sharded_keeps_replicated():
         a1.param_bytes_sharded_per_device
     assert a1.param_bytes_replicated == a2.param_bytes_replicated
     assert a2.pool_bytes_per_device * 2 == a1.pool_bytes_per_device
-    # the tied embedding/head is the dominant replicated buffer by far
-    top = max((b for b in a2.buffers if not b.sharded), key=lambda b: b.bytes)
-    assert top.name == "wte"
-    assert top.bytes == e1.config.vocab_size * e1.config.hidden_size * 4
+    # the tied embedding/head is vocab-sharded (its per-device share halves
+    # with mp); what remains replicated is the small norm/bias tail, all of
+    # it under the declared JXP006 ceiling
+    wte = next(b for b in a2.buffers if b.name == "wte")
+    assert wte.sharded
+    assert wte.bytes == e1.config.vocab_size * e1.config.hidden_size * 4
+    assert a2.param_bytes_replicated < wte.bytes
 
 
 def test_jxp006_replicated_ceiling():
-    """A replicated buffer above the ceiling is flagged at mp>1 and named;
-    on one chip replication is free and the ceiling does not apply."""
+    """A replicated buffer above the ceiling is flagged at mp>1 and named —
+    but never the vocab-sharded `wte`, which left the replicated column; on
+    one chip replication is free and the ceiling does not apply."""
     e2, _ = _build_engine(2)
     a2 = engine_at_rest(e2)
-    _, fs = audit_resources([], a2, {"replicated_bytes_ceiling": 1000})
-    assert any(f.rule == "JXP006" and "wte" in f.message for f in fs)
+    # squeeze below the largest surviving replicated leaf: JXP006 fires and
+    # names a norm/bias buffer, not the (sharded) embedding/head
+    top = max((b for b in a2.buffers
+               if not b.sharded and not b.name.startswith("pool.")),
+              key=lambda b: b.bytes)
+    _, fs = audit_resources([], a2,
+                            {"replicated_bytes_ceiling": top.bytes - 1})
+    assert any(f.rule == "JXP006" and f"`{top.name}`" in f.message
+               for f in fs)
+    assert not any("wte" in f.message for f in fs)
     _, fs = audit_resources([], a2, {"replicated_bytes_ceiling": 1 << 30})
     assert fs == []
     e1, _ = _build_engine(1)
@@ -300,9 +313,15 @@ def test_serving_resource_budget_clean():
     fused = next(p for p in rep1["programs"] if "fused" in p["name"])
     assert fused["out_bytes"] - fused["alias_bytes"] < 1024
     if 2 in reports:
-        names = {p["name"] for p in reports[2]["programs"]}
-        declared = set(SERVE_RESOURCE_BUDGET["collective_bytes_per_step"])
-        # every mp2 serving program that communicates is declared by name
+        # every declared communicating program exists in the mp pass whose
+        # namespace it carries (serve.mp2.* under mp=2, serve.mp4.* under
+        # mp=4) — a stale registry key fails here, an undeclared collective
+        # fails JXP007 above
+        names = {p["name"] for m, rep in reports.items() if m > 1
+                 for p in rep["programs"]}
+        declared = {k for k in SERVE_RESOURCE_BUDGET[
+            "collective_bytes_per_step"]
+            if int(k.split(".")[1][2:]) in reports}
         assert declared <= names
 
 

@@ -43,7 +43,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_HISTORY = os.path.join(_REPO, "BENCH_SERVE.jsonl")
 
-ROW_SCHEMA_VERSION = 4
+ROW_SCHEMA_VERSION = 5
 
 # the axes that make rows comparable across PRs: two rows agree on "mode"
 # or their perf numbers are not the same experiment.  v1 rows (pre KV
@@ -88,10 +88,15 @@ PERF_KEYS_V3 = PERF_KEYS_V2 + (
     "round_robin_returning_ttft_p50_ms", "fleet_shared_executables")
 # v4: the disaggregation surface — store-handoff latency, the prefill-
 # interference delta on decode TPOT, and the restart restore sub-pass
-PERF_KEYS = PERF_KEYS_V3 + (
+PERF_KEYS_V4 = PERF_KEYS_V3 + (
     "handoff_p50_ms", "handoff_p99_ms", "handoff_count",
     "interference_tpot_delta_ms", "restart_restored_tokens",
     "restart_ttft_ms")
+# v5 (vocab-sharded head PR): the at-rest param-placement surface — per-
+# device replicated vs sharded bytes next to the fp wte size, so the
+# "replicated embedding ceiling" stays visibly retired across PRs
+PERF_KEYS = PERF_KEYS_V4 + (
+    "replicated_bytes_per_device", "sharded_bytes_per_device", "wte_bytes")
 PARITY_KEYS = ("fuse_parity", "spec_parity", "oversubscribe_parity",
                "tracing_parity", "kv_tier_parity", "fleet_parity",
                "disagg_parity")
@@ -100,7 +105,8 @@ REQUIRED_ROW_KEYS = frozenset({"schema_version", "t", "mode", "perf",
 _AXES_BY_VERSION = {1: (MODE_AXES_V1, PERF_KEYS_V1),
                     2: (MODE_AXES_V2, PERF_KEYS_V2),
                     3: (MODE_AXES_V3, PERF_KEYS_V3),
-                    4: (MODE_AXES, PERF_KEYS)}
+                    4: (MODE_AXES, PERF_KEYS_V4),
+                    5: (MODE_AXES, PERF_KEYS)}
 
 
 def bench_row(stats, t=None):
@@ -217,6 +223,18 @@ def check_floors(row, floors=None):
             errors.append("fleet_shared_executables is not True — dp "
                           "replicas stopped adopting the leader's compiled "
                           "programs (replication must add zero executables)")
+    # vocab-sharded head floor: at mp>=2 the per-device replicated param
+    # bytes must sit STRICTLY below the fp wte size — the exact ceiling the
+    # sharded layout retired.  Deterministic (byte counts off the cached
+    # cost account, not wall clock); only v5+ rows carry the fields.
+    if floors.get("replicated_below_wte") and (mode.get("mp") or 1) >= 2:
+        rep = perf.get("replicated_bytes_per_device")
+        wte = perf.get("wte_bytes")
+        if isinstance(rep, (int, float)) and isinstance(wte, (int, float)) \
+                and rep >= wte:
+            errors.append(f"replicated_bytes_per_device {rep} not strictly "
+                          f"below wte_bytes {wte} at mp>=2 — the embedding/"
+                          f"head replication ceiling is back")
     # disaggregation floor: every handoff must complete within the declared
     # ceiling (a store handoff slower than a re-prefill defeats the split)
     if mode.get("disagg"):

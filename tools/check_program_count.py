@@ -205,7 +205,10 @@ def main() -> int:
     rc = 0
     report = {"metric": "serve_compiled_program_count", "ok": True}
     digests = {}
-    for mp, budget in ((1, BUDGET), (2, BUDGET_MP)):
+    # mp4 rides the same MP budget: the fused program PARTITIONS over the
+    # mesh, it does not fork — the vocab-sharded head included (the sharded
+    # argmax/sample merges live inside the one fused executable)
+    for mp, budget in ((1, BUDGET), (2, BUDGET_MP), (4, BUDGET_MP)):
         got, stats = measure(mp=mp)
         digests[mp] = stats["outputs_digest"]
         over = {k: (got[k], budget[k]) for k in budget if got[k] > budget[k]}
@@ -220,14 +223,16 @@ def main() -> int:
                 print(f"FAIL[{tag}]: {k} = {g} exceeds documented budget {b} "
                       f"— a code path is recompiling per shape; see README "
                       f"'Serving'", file=sys.stderr)
-    # mp serving must be a pure partitioning of the same computation: the two
-    # passes replay the same stream, so greedy outputs must match exactly
-    report["mp_parity"] = digests[1] == digests[2]
+    # mp serving must be a pure partitioning of the same computation: every
+    # pass replays the same stream, so greedy outputs must match BYTE-exactly
+    # across the whole mesh ladder (the sharded argmax/top-k tie-break is
+    # deterministic by construction)
+    report["mp_parity"] = digests[1] == digests[2] == digests[4]
     if not report["mp_parity"]:
         report["ok"] = False
         rc = 1
-        print("FAIL: mp=2 serving outputs diverge from single-chip (greedy "
-              "token parity broken)", file=sys.stderr)
+        print("FAIL: mp>1 serving outputs diverge from single-chip (greedy "
+              "token parity broken across the mesh ladder)", file=sys.stderr)
     # dp fleet pass: replication shares the leader's compiled set — every
     # replica inside the SAME single-engine budget, executables identical
     fleet_per, fleet_shared = measure_fleet()

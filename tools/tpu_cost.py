@@ -27,7 +27,7 @@ SERVE_RESOURCE_BUDGET`, next to the program-count budget — one declaration,
 one yardstick for the quantized-KV and 70B-head roadmap arcs.
 
 Usage:
-  JAX_PLATFORMS=cpu python tools/tpu_cost.py          # human report, mp1+mp2
+  JAX_PLATFORMS=cpu python tools/tpu_cost.py          # human report, mp1/2/4
   JAX_PLATFORMS=cpu python tools/tpu_cost.py --ci     # enforce budgets (CI)
   python tools/tpu_cost.py --json                     # machine-readable
   python tools/tpu_cost.py --no-mp                    # single-device hosts
@@ -102,7 +102,7 @@ def main() -> int:
     ap.add_argument("--json", action="store_true",
                     help="emit one JSON object with the full account")
     ap.add_argument("--no-mp", action="store_true",
-                    help="skip the mp=2 pass (single-device hosts)")
+                    help="skip the mp=2/mp=4 passes (single-device hosts)")
     ap.add_argument("--replicated-ceiling", type=int, default=None,
                     help="override the declared replicated-bytes ceiling "
                          "(budget-injection hook for tests)")
